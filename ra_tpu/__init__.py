@@ -1,0 +1,10 @@
+"""ra_tpu — a TPU-native multi-raft state machine replication framework.
+
+Capabilities follow rabbitmq/ra (persistent fault-tolerant replicated
+state machines; thousands of Raft groups sharing one WAL), re-designed
+TPU-first: the consensus decision hot path runs as vectorized JAX kernels
+over group-id-indexed device arrays, while log/WAL/snapshot I/O stays on
+the host.
+"""
+
+__version__ = "0.1.0"

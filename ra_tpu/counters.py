@@ -1,0 +1,159 @@
+"""Named counter/gauge registry.
+
+Capability parity with the reference's ``ra_counters`` facade over the
+seshat dep (reference: ``src/ra_counters.erl:10-22``) and the per-server
+counter taxonomy (reference: ``src/ra.hrl:266-438``): every server (and the
+WAL / segment writer) registers a fixed-width array of int64 slots, updated
+lock-free on the hot path and readable by observers at any time.
+
+Implementation: one numpy int64 vector per registered object. CPython's
+GIL plus single-writer-per-slot discipline (each slot is only incremented
+from its owner's event loop) makes plain ``arr[i] += n`` safe here; readers
+may see slightly stale values, matching the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# (name, kind, help). Kind: "counter" (monotone) or "gauge".
+FieldSpec = Tuple[str, str, str]
+
+# Per-server counter fields — same information set as the reference's
+# ra_server counter index definitions (src/ra.hrl:266-438).
+RA_SERVER_FIELDS: List[FieldSpec] = [
+    ("commands", "counter", "commands received by the leader"),
+    ("msgs_sent", "counter", "protocol messages sent"),
+    ("dropped_sends", "counter", "sends dropped due to backpressure"),
+    ("send_msg_effects_sent", "counter", "send_msg effects executed"),
+    ("commit_index", "gauge", "current commit index"),
+    ("last_applied", "gauge", "last applied index"),
+    ("commit_latency", "gauge", "approx entry-write->commit latency ms"),
+    ("term", "gauge", "current term"),
+    ("last_index", "gauge", "last log index"),
+    ("last_written_index", "gauge", "last durably written log index"),
+    ("snapshot_index", "gauge", "current snapshot index"),
+    ("snapshots_written", "counter", "snapshots written"),
+    ("snapshot_installed", "counter", "snapshots installed (follower)"),
+    ("checkpoints_written", "counter", "checkpoints written"),
+    ("checkpoints_promoted", "counter", "checkpoints promoted to snapshots"),
+    ("checkpoint_index", "gauge", "latest checkpoint index"),
+    ("aer_received", "counter", "append_entries RPCs received"),
+    ("aer_received_followers", "counter", "AERs received while follower"),
+    ("aer_replies_success", "counter", "successful AER replies sent"),
+    ("aer_replies_failed", "counter", "failed AER replies sent"),
+    ("elections", "counter", "elections started"),
+    ("pre_vote_elections", "counter", "pre-vote rounds started"),
+    ("force_elections", "counter", "forced elections"),
+    ("applied", "counter", "entries applied to the machine"),
+    ("releases", "counter", "release-cursor truncations"),
+    ("reserved_1", "counter", "reserved"),
+    ("num_segments", "gauge", "number of live segment files"),
+    ("compactions", "counter", "compactions run"),
+    ("local_queries", "counter", "local queries served"),
+    ("leader_queries", "counter", "leader queries served"),
+    ("consistent_queries", "counter", "consistent queries served"),
+    ("read_issued", "counter", "log reads issued"),
+    ("read_cache", "counter", "log reads served from memtable"),
+    ("read_segment", "counter", "log reads served from segments"),
+    ("open_segments", "gauge", "open segment fds"),
+    ("commit_rate", "gauge", "commit rate (entries/sec, smoothed)"),
+]
+
+WAL_FIELDS: List[FieldSpec] = [
+    ("wal_files", "counter", "WAL files opened"),
+    ("batches", "counter", "write batches flushed"),
+    ("writes", "counter", "entries written"),
+    ("bytes_written", "counter", "bytes written"),
+    ("fsyncs", "counter", "fsync calls"),
+    ("fsync_time_us", "counter", "cumulative fsync time (us)"),
+    ("batch_size", "gauge", "last batch size"),
+    ("out_of_seq", "counter", "out-of-sequence writes detected"),
+    ("rollovers", "counter", "WAL file rollovers"),
+]
+
+SEGMENT_WRITER_FIELDS: List[FieldSpec] = [
+    ("mem_tables_flushed", "counter", "memtable flush jobs"),
+    ("entries_flushed", "counter", "entries flushed to segments"),
+    ("segments_created", "counter", "segment files created"),
+    ("bytes_flushed", "counter", "bytes flushed"),
+]
+
+
+class Counters:
+    """A fixed set of int64 slots addressed by field name."""
+
+    __slots__ = ("name", "fields", "_idx", "arr")
+
+    def __init__(self, name, fields: Sequence[FieldSpec]):
+        self.name = name
+        self.fields = list(fields)
+        self._idx: Dict[str, int] = {f[0]: i for i, f in enumerate(self.fields)}
+        self.arr = np.zeros(len(self.fields), dtype=np.int64)
+
+    def incr(self, field: str, n: int = 1) -> None:
+        self.arr[self._idx[field]] += n
+
+    def put(self, field: str, v: int) -> None:
+        self.arr[self._idx[field]] = v
+
+    def get(self, field: str) -> int:
+        return int(self.arr[self._idx[field]])
+
+    def to_dict(self) -> Dict[str, int]:
+        return {f[0]: int(self.arr[i]) for i, f in enumerate(self.fields)}
+
+
+class CounterRegistry:
+    """Process-global registry: name -> Counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tab: Dict[object, Counters] = {}
+
+    def new(self, name, fields: Sequence[FieldSpec]) -> Counters:
+        with self._lock:
+            c = self._tab.get(name)
+            if c is None or [f[0] for f in c.fields] != [f[0] for f in fields]:
+                c = Counters(name, fields)
+                self._tab[name] = c
+            return c
+
+    def fetch(self, name) -> Optional[Counters]:
+        return self._tab.get(name)
+
+    def delete(self, name) -> None:
+        with self._lock:
+            self._tab.pop(name, None)
+
+    def overview(self) -> Dict[object, Dict[str, int]]:
+        return {k: v.to_dict() for k, v in list(self._tab.items())}
+
+    def names(self) -> List[object]:
+        return list(self._tab.keys())
+
+
+_global = CounterRegistry()
+
+
+def registry() -> CounterRegistry:
+    return _global
+
+
+def new(name, fields: Sequence[FieldSpec] = RA_SERVER_FIELDS) -> Counters:
+    return _global.new(name, fields)
+
+
+def fetch(name) -> Optional[Counters]:
+    return _global.fetch(name)
+
+
+def delete(name) -> None:
+    _global.delete(name)
+
+
+def overview() -> Dict[object, Dict[str, int]]:
+    return _global.overview()

@@ -1,0 +1,131 @@
+"""Small shared utilities: UId generation, zero-padded filenames, atomic
+file writes, retries, parallel helpers.
+
+Capability parity with the reference's ``src/ra_lib.erl`` (make_uid,
+zpad_hex, write_file + sync, retry, partition_parallel) and
+``src/ra_file.erl`` (retrying file ops), re-done with Python/os primitives.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import string
+import tempfile
+import time
+from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait as fut_wait
+from typing import Callable, Iterable, List, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_UID_ALPHABET = string.ascii_uppercase + string.digits
+
+
+def make_uid(prefix: str = "", n: int = 12) -> str:
+    """Unique, filesystem-safe id (uppercase alphanumeric)."""
+    body = "".join(secrets.choice(_UID_ALPHABET) for _ in range(n))
+    return (prefix + body) if prefix else body
+
+
+def validate_name(name: str) -> bool:
+    """Names must be safe for use in file paths and registries."""
+    ok = set(string.ascii_letters + string.digits + "_-.")
+    return bool(name) and all(c in ok for c in name) and name not in (".", "..")
+
+
+def zpad_hex(n: int, width: int = 16) -> str:
+    return format(n, f"0{width}X")
+
+
+def zpad_filename(prefix: str, ext: str, n: int, width: int = 16) -> str:
+    base = f"{n:0{width}d}.{ext}"
+    return f"{prefix}_{base}" if prefix else base
+
+
+def atomic_write(path: str, data: bytes, fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically (tmp file + rename), with
+    optional fsync of the file and its directory."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=f".{os.path.basename(path)}.", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        sync_dir(d)
+
+
+def sync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def retry(fn: Callable[[], T], attempts: int = 3, delay_s: float = 0.05) -> T:
+    last: Exception | None = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - retry any failure
+            last = e
+            if i + 1 < attempts:
+                time.sleep(delay_s)
+    assert last is not None
+    raise last
+
+
+def partition_parallel(
+    fn: Callable[[T], R], items: Sequence[T], max_workers: int = 16, timeout_s: float = 30.0
+) -> Tuple[List[Tuple[T, R]], List[Tuple[T, BaseException]]]:
+    """Run fn over items in parallel; return (oks, errors) partitions.
+
+    Mirrors the reference's parallel cluster start helper
+    (reference: src/ra_lib.erl partition_parallel, src/ra.erl:397-404).
+    """
+    oks: List[Tuple[T, R]] = []
+    errs: List[Tuple[T, BaseException]] = []
+    if not items:
+        return oks, errs
+    ex = ThreadPoolExecutor(max_workers=min(max_workers, len(items)))
+    try:
+        futs: dict[Future, T] = {ex.submit(fn, item): item for item in items}
+        deadline = time.monotonic() + timeout_s
+        pending = set(futs)
+        while pending:
+            done, pending = fut_wait(
+                pending, timeout=max(0.0, deadline - time.monotonic()), return_when=FIRST_COMPLETED
+            )
+            for fut in done:
+                item = futs[fut]
+                try:
+                    oks.append((item, fut.result()))
+                except BaseException as e:  # noqa: BLE001
+                    errs.append((item, e))
+            if not done and time.monotonic() >= deadline:
+                for fut in pending:
+                    fut.cancel()
+                    errs.append((futs[fut], TimeoutError(f"timed out after {timeout_s}s")))
+                break
+    finally:
+        # Don't block on hung workers: overall wall time is bounded by the
+        # deadline above even if a task never returns.
+        ex.shutdown(wait=False)
+    return oks, errs
+
+
+def derive_dir(base: str, *parts: str) -> str:
+    p = os.path.join(base, *parts)
+    os.makedirs(p, exist_ok=True)
+    return p

@@ -1,0 +1,161 @@
+"""Unit tests for range algebra, FLRU, lib utils, counters, system config."""
+
+import os
+
+import pytest
+
+from ra_tpu import counters as cnt
+from ra_tpu import system as ra_system
+from ra_tpu.utils import range as rr
+from ra_tpu.utils.flru import FLRU
+from ra_tpu.utils import lib
+
+
+# -- range ----------------------------------------------------------------
+
+def test_range_basics():
+    assert rr.new(1, 5) == (1, 5)
+    assert rr.new(5, 1) is None
+    assert rr.size((1, 5)) == 5
+    assert rr.size(None) == 0
+    assert rr.contains((1, 5), 3)
+    assert not rr.contains(None, 3)
+    assert rr.extend((1, 5), 6) == (1, 6)
+    assert rr.extend(None, 4) == (4, 4)
+    with pytest.raises(ValueError):
+        rr.extend((1, 5), 7)
+
+
+def test_range_trim_overlap_subtract():
+    assert rr.limit((1, 10), 5) == (1, 5)
+    assert rr.limit((1, 10), 0) is None
+    assert rr.floor((1, 10), 5) == (5, 10)
+    assert rr.truncate((1, 10), 3) == (4, 10)
+    assert rr.truncate((1, 10), 10) is None
+    assert rr.overlap((1, 10), (5, 20)) == (5, 10)
+    assert rr.overlap((1, 4), (5, 20)) is None
+    assert rr.union((1, 4), (5, 20)) == (1, 20)
+    assert rr.subtract((1, 10), (4, 6)) == [(1, 3), (7, 10)]
+    assert rr.subtract((1, 10), (1, 10)) == []
+    assert rr.subtract((1, 10), None) == [(1, 10)]
+
+
+# -- FLRU -----------------------------------------------------------------
+
+def test_flru_eviction_order_and_handler():
+    evicted = []
+    c = FLRU(2, on_evict=lambda k, v: evicted.append((k, v)))
+    c.insert("a", 1)
+    c.insert("b", 2)
+    assert c.get("a") == 1  # refresh a
+    c.insert("c", 3)  # evicts b (LRU)
+    assert evicted == [("b", 2)]
+    assert c.get("b") is None
+    assert len(c) == 2
+    c.evict("a")
+    assert evicted[-1] == ("a", 1)
+    c.evict_all()
+    assert len(c) == 0
+    assert evicted[-1] == ("c", 3)
+
+
+# -- lib ------------------------------------------------------------------
+
+def test_make_uid_and_names():
+    uids = {lib.make_uid() for _ in range(100)}
+    assert len(uids) == 100
+    assert all(len(u) == 12 for u in uids)
+    assert lib.validate_name("cluster-1.a_b")
+    assert not lib.validate_name("has space")
+    assert not lib.validate_name("")
+    assert not lib.validate_name("..")
+
+
+def test_zpad():
+    assert lib.zpad_hex(255, 8) == "000000FF"
+    assert lib.zpad_filename("", "wal", 3, 8) == "00000003.wal"
+    assert lib.zpad_filename("w", "segment", 12, 8) == "w_00000012.segment"
+
+
+def test_atomic_write(tmp_path):
+    p = str(tmp_path / "f.bin")
+    lib.atomic_write(p, b"hello")
+    assert open(p, "rb").read() == b"hello"
+    lib.atomic_write(p, b"world")
+    assert open(p, "rb").read() == b"world"
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        return "ok"
+
+    assert lib.retry(flaky, attempts=5, delay_s=0) == "ok"
+    with pytest.raises(RuntimeError):
+        lib.retry(lambda: (_ for _ in ()).throw(RuntimeError("x")), attempts=2, delay_s=0)
+
+
+def test_partition_parallel():
+    oks, errs = lib.partition_parallel(lambda x: x * 2, [1, 2, 3, 4])
+    assert sorted(r for _, r in oks) == [2, 4, 6, 8]
+    assert errs == []
+
+    def maybe_fail(x):
+        if x % 2:
+            raise ValueError(x)
+        return x
+
+    oks, errs = lib.partition_parallel(maybe_fail, [1, 2, 3, 4])
+    assert sorted(i for i, _ in oks) == [2, 4]
+    assert sorted(i for i, _ in errs) == [1, 3]
+
+
+# -- counters -------------------------------------------------------------
+
+def test_counters_basic():
+    c = cnt.new(("srv", "test1"))
+    c.incr("commands")
+    c.incr("commands", 5)
+    c.put("commit_index", 42)
+    assert c.get("commands") == 6
+    assert c.get("commit_index") == 42
+    assert cnt.fetch(("srv", "test1")) is c
+    ov = cnt.overview()
+    assert ov[("srv", "test1")]["commands"] == 6
+    cnt.delete(("srv", "test1"))
+    assert cnt.fetch(("srv", "test1")) is None
+
+
+def test_counters_wal_fields():
+    c = cnt.new("wal_x", cnt.WAL_FIELDS)
+    c.incr("fsyncs")
+    assert c.to_dict()["fsyncs"] == 1
+    cnt.delete("wal_x")
+
+
+# -- system config --------------------------------------------------------
+
+def test_system_config_defaults(tmp_path):
+    cfg = ra_system.SystemConfig(name="s1", data_dir=str(tmp_path))
+    assert cfg.names.wal == "ra_s1_wal"
+    assert cfg.wal_max_size_bytes == 256 * 1024 * 1024
+    assert cfg.default_max_append_entries_rpc_batch_size == 128
+    assert cfg.server_data_dir("UID1") == str(tmp_path / "UID1")
+    assert cfg.server_impl == "per_group_actor"
+
+
+def test_system_registry():
+    reg = ra_system.registry()
+    cfg = ra_system.SystemConfig(name="regtest", data_dir="/tmp/x")
+    reg.put("regtest", cfg)
+    assert reg.get("regtest") is cfg
+    with pytest.raises(RuntimeError):
+        reg.put("regtest", cfg)
+    assert "regtest" in reg.names()
+    assert reg.pop("regtest") is cfg
+    assert reg.get("regtest") is None

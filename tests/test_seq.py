@@ -1,0 +1,99 @@
+"""Sparse-sequence unit tests (capability model: reference test/ra_seq_SUITE.erl)."""
+
+import random
+
+import pytest
+
+from ra_tpu.utils.seq import Seq
+
+
+def test_empty():
+    s = Seq.empty()
+    assert s.is_empty()
+    assert len(s) == 0
+    assert s.first() is None
+    assert s.last() is None
+    assert list(s) == []
+    assert s.range() is None
+
+
+def test_append_contiguous_and_sparse():
+    s = Seq.empty().append(1).append(2).append(3)
+    assert s.ranges() == [(1, 3)]
+    s = s.append(5)
+    assert s.ranges() == [(1, 3), (5, 5)]
+    s = s.append(6).append(10)
+    assert s.ranges() == [(1, 3), (5, 6), (10, 10)]
+    assert len(s) == 6
+    assert s.first() == 1 and s.last() == 10
+    assert s.range() == (1, 10)
+
+
+def test_append_non_monotone_raises():
+    s = Seq.from_list([1, 2, 3])
+    with pytest.raises(ValueError):
+        s.append(3)
+    with pytest.raises(ValueError):
+        s.append(1)
+
+
+def test_from_list_and_membership():
+    s = Seq.from_list([5, 1, 2, 9, 8, 3])
+    assert s.ranges() == [(1, 3), (5, 5), (8, 9)]
+    for i in [1, 2, 3, 5, 8, 9]:
+        assert i in s
+    for i in [0, 4, 6, 7, 10]:
+        assert i not in s
+    assert list(s) == [1, 2, 3, 5, 8, 9]
+    assert list(reversed(s)) == [9, 8, 5, 3, 2, 1]
+
+
+def test_floor_limit():
+    s = Seq.from_list([1, 2, 3, 5, 8, 9])
+    assert s.floor(3).ranges() == [(3, 3), (5, 5), (8, 9)]
+    assert s.floor(6).ranges() == [(8, 9)]
+    assert s.limit(5).ranges() == [(1, 3), (5, 5)]
+    assert s.limit(0).is_empty()
+    assert s.floor(10).is_empty()
+    assert s.in_range(2, 8).ranges() == [(2, 3), (5, 5), (8, 8)]
+
+
+def test_subtract_intersect_union():
+    a = Seq.from_range(1, 10)
+    b = Seq.from_list([3, 4, 7])
+    assert a.subtract(b).ranges() == [(1, 2), (5, 6), (8, 10)]
+    assert a.intersect(b) == b
+    assert b.subtract(a).is_empty()
+    assert a.union(b) == a
+    c = Seq.from_list([20, 21])
+    assert a.union(c).ranges() == [(1, 10), (20, 21)]
+
+
+def test_subtract_random_model():
+    rng = random.Random(42)
+    for _ in range(200):
+        xs = set(rng.sample(range(50), rng.randint(0, 30)))
+        ys = set(rng.sample(range(50), rng.randint(0, 30)))
+        a, b = Seq.from_list(xs), Seq.from_list(ys)
+        assert set(a.subtract(b)) == xs - ys
+        assert set(a.intersect(b)) == xs & ys
+        assert set(a.union(b)) == xs | ys
+
+
+def test_list_chunk():
+    s = Seq.from_list([1, 2, 3, 10, 11, 30])
+    chunk, rest = s.list_chunk(4)
+    assert chunk == [1, 2, 3, 10]
+    assert list(rest) == [11, 30]
+    chunk2, rest2 = rest.list_chunk(10)
+    assert chunk2 == [11, 30]
+    assert rest2.is_empty()
+    chunk3, rest3 = rest2.list_chunk(4)
+    assert chunk3 == [] and rest3.is_empty()
+
+
+def test_add():
+    s = Seq.from_list([1, 5])
+    assert s.add(3).ranges() == [(1, 1), (3, 3), (5, 5)]
+    assert s.add(2).ranges() == [(1, 2), (5, 5)]
+    assert s.add(5) == s
