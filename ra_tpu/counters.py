@@ -117,9 +117,15 @@ class CounterRegistry:
     def new(self, name, fields: Sequence[FieldSpec]) -> Counters:
         with self._lock:
             c = self._tab.get(name)
-            if c is None or [f[0] for f in c.fields] != [f[0] for f in fields]:
+            if c is None:
                 c = Counters(name, fields)
                 self._tab[name] = c
+            elif [f[0] for f in c.fields] != [f[0] for f in fields]:
+                # replacing a live counters object would zero its values and
+                # orphan existing holders — make the conflict loud instead
+                raise ValueError(
+                    f"counters {name!r} already registered with a different field set"
+                )
             return c
 
     def fetch(self, name) -> Optional[Counters]:

@@ -1,0 +1,211 @@
+"""In-memory log with controllable durability watermark.
+
+The oracle's storage fake (cf. reference ``test/ra_log_memory.erl`` —
+a pure map implementation of the full log API with fake async
+``last_written``). With ``auto_written=True`` every write is durable
+immediately; with ``auto_written=False`` the test (or in-proc runtime)
+must drain ``pending_written_events()`` and feed them back through
+``handle_event`` to advance the watermark — exactly how the real WAL's
+written notifications behave.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ra_tpu.log.api import LogApi
+from ra_tpu.protocol import Entry, SnapshotMeta
+from ra_tpu.utils.seq import Seq
+
+
+class MemoryLog(LogApi):
+    def __init__(self, auto_written: bool = True):
+        self.entries: Dict[int, Entry] = {}
+        self._last_index = 0
+        self._last_term = 0
+        self._written_index = 0
+        self._written_term = 0
+        self._first_index = 1
+        self.auto_written = auto_written
+        self._pending: Seq = Seq.empty()
+        self._snapshot: Optional[Tuple[SnapshotMeta, Any]] = None
+        self._checkpoints: List[Tuple[SnapshotMeta, Any]] = []
+
+    # -- writes ------------------------------------------------------------
+
+    def append(self, entry: Entry) -> None:
+        if entry.index != self._last_index + 1:
+            raise ValueError(
+                f"non-contiguous append: {entry.index} after {self._last_index}"
+            )
+        self._store(entry)
+
+    def write(self, entries: Sequence[Entry]) -> None:
+        if not entries:
+            return
+        first = entries[0].index
+        if first > self._last_index + 1:
+            raise ValueError(f"gap: write at {first}, last is {self._last_index}")
+        if first <= self._last_index:
+            # Overwrite: truncate divergent suffix, rewind watermark
+            # (cf. src/ra_log.erl:560-580 last_written rewind).
+            self.set_last_index(first - 1)
+        for e in entries:
+            self._store(e)
+
+    def _store(self, e: Entry) -> None:
+        self.entries[e.index] = e
+        self._last_index = e.index
+        self._last_term = e.term
+        if self.auto_written:
+            self._written_index = e.index
+            self._written_term = e.term
+        else:
+            self._pending = self._pending.add(e.index)
+
+    def set_last_index(self, idx: int) -> None:
+        for i in range(idx + 1, self._last_index + 1):
+            self.entries.pop(i, None)
+        self._last_index = idx
+        t = self.fetch_term(idx)
+        self._last_term = t if t is not None else 0
+        if self._written_index > idx:
+            self._written_index = idx
+            self._written_term = self._last_term
+        self._pending = self._pending.limit(idx)
+
+    # -- durability simulation --------------------------------------------
+
+    def pending_written_events(self) -> List[Any]:
+        """Drain pending writes as ("written", term, seq) events."""
+        if self._pending.is_empty():
+            return []
+        evts = []
+        # group pending by term, preserving order
+        cur_term = None
+        cur: List[int] = []
+        for idx in self._pending:
+            e = self.entries.get(idx)
+            if e is None:
+                continue
+            if cur_term is None or e.term == cur_term:
+                cur_term = e.term
+                cur.append(idx)
+            else:
+                evts.append(("written", cur_term, Seq.from_list(cur)))
+                cur_term, cur = e.term, [idx]
+        if cur:
+            evts.append(("written", cur_term, Seq.from_list(cur)))
+        self._pending = Seq.empty()
+        return evts
+
+    def handle_event(self, evt: Any) -> List[Any]:
+        if isinstance(evt, tuple) and evt and evt[0] == "written":
+            _, term, seq = evt
+            if seq is None:  # durability already reflected (auto mode)
+                return []
+            last = seq.last()
+            if last is None:
+                return []
+            # Only advance if the entry we wrote is still the one in the
+            # log at that index (it may have been overwritten since).
+            e = self.entries.get(last)
+            if e is not None and e.term == term and last > self._written_index:
+                self._written_index = last
+                self._written_term = term
+            return []
+        return []
+
+    # -- reads -------------------------------------------------------------
+
+    def last_index_term(self) -> Tuple[int, int]:
+        return self._last_index, self._last_term
+
+    def last_written(self) -> Tuple[int, int]:
+        return self._written_index, self._written_term
+
+    def first_index(self) -> int:
+        return self._first_index
+
+    def fetch(self, idx: int) -> Optional[Entry]:
+        return self.entries.get(idx)
+
+    def fetch_term(self, idx: int) -> Optional[int]:
+        e = self.entries.get(idx)
+        if e is not None:
+            return e.term
+        if self._snapshot is not None and idx == self._snapshot[0].index:
+            return self._snapshot[0].term
+        if idx == 0:
+            return 0
+        return None
+
+    def fold(self, lo: int, hi: int, fn: Callable[[Entry, Any], Any], acc: Any) -> Any:
+        for i in range(lo, hi + 1):
+            e = self.entries.get(i)
+            if e is None:
+                raise KeyError(f"missing log entry {i}")
+            acc = fn(e, acc)
+        return acc
+
+    def sparse_read(self, idxs: Sequence[int]) -> List[Entry]:
+        return [self.entries[i] for i in idxs if i in self.entries]
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot_index_term(self) -> Optional[Tuple[int, int]]:
+        if self._snapshot is None:
+            return None
+        m = self._snapshot[0]
+        return (m.index, m.term)
+
+    def snapshot_meta(self) -> Optional[SnapshotMeta]:
+        return self._snapshot[0] if self._snapshot else None
+
+    def install_snapshot(self, meta: SnapshotMeta, machine_state: Any) -> List[Any]:
+        self._snapshot = (meta, machine_state)
+        live = set(meta.live_indexes)
+        for i in list(self.entries):
+            if i <= meta.index and i not in live:
+                del self.entries[i]
+        self._first_index = meta.index + 1
+        if self._last_index < meta.index:
+            self._last_index = meta.index
+            self._last_term = meta.term
+        if self._written_index < meta.index:
+            self._written_index = meta.index
+            self._written_term = meta.term
+        self._pending = self._pending.floor(meta.index + 1)
+        return []
+
+    def update_release_cursor(self, idx, cluster, machine_version, machine_state) -> List[Any]:
+        if idx <= (self._snapshot[0].index if self._snapshot else 0):
+            return []
+        t = self.fetch_term(idx)
+        if t is None:
+            return []
+        meta = SnapshotMeta(
+            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version
+        )
+        return self.install_snapshot(meta, machine_state)
+
+    def checkpoint(self, idx, cluster, machine_version, machine_state) -> List[Any]:
+        t = self.fetch_term(idx)
+        if t is None:
+            return []
+        meta = SnapshotMeta(
+            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version
+        )
+        self._checkpoints.append((meta, machine_state))
+        return []
+
+    def promote_checkpoint(self, idx: int) -> List[Any]:
+        eligible = [cp for cp in self._checkpoints if cp[0].index <= idx]
+        if not eligible:
+            return []
+        meta, state = max(eligible, key=lambda cp: cp[0].index)
+        self._checkpoints = [cp for cp in self._checkpoints if cp[0].index > meta.index]
+        return self.install_snapshot(meta, state)
+
+    def read_snapshot(self) -> Optional[Tuple[SnapshotMeta, Any]]:
+        return self._snapshot

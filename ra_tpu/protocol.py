@@ -1,0 +1,196 @@
+"""Wire protocol records.
+
+Capability parity with the reference's protocol record definitions
+(reference: ``src/ra.hrl:122-211``): AppendEntries carries full prev-idx/
+term matching info; the AppendEntries *reply* carries the follower's
+``next_index`` hint plus its ``last_index``/``last_term`` (a deliberate
+deviation from vanilla Raft the reference relies on for stale-reply
+detection); pre-vote carries a token and version info; install-snapshot is
+chunked with an ``(num, phase)`` chunk state.
+
+These records double as the schema for the TPU batch backend: every fixed-
+width field here becomes a column in the device-resident RPC batch arrays
+(see ra_tpu.ops.consensus).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+ServerId = Tuple[str, str]  # (cluster-unique server name, node name)
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    index: int
+    term: int
+    cmd: Any  # Command
+
+
+# -- commands stored in the log -------------------------------------------
+
+USR = "usr"  # user machine command
+NOOP = "noop"  # leader-election noop (carries machine version)
+RA_JOIN = "ra_join"
+RA_LEAVE = "ra_leave"
+RA_CLUSTER_CHANGE = "ra_cluster_change"
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    kind: str  # one of the constants above
+    data: Any = None
+    # reply mode: "after_log_append" | "await_consensus" | "noreply"
+    # | ("notify", corr, caller)
+    reply_mode: Any = "noreply"
+    # caller ref for synchronous replies (opaque to the core)
+    from_ref: Any = None
+    machine_version: int = 0  # only meaningful for NOOP
+
+
+# -- snapshot metadata -----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotMeta:
+    index: int
+    term: int
+    cluster: Tuple[ServerId, ...]
+    machine_version: int
+    # sparse live indexes above `index` that must be retained in the log
+    live_indexes: Tuple[int, ...] = ()
+
+
+# -- RPCs ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesRpc:
+    term: int
+    leader_id: ServerId
+    prev_log_index: int
+    prev_log_term: int
+    leader_commit: int
+    entries: Tuple[Entry, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class AppendEntriesReply:
+    term: int
+    success: bool
+    # follower's expectation/bookkeeping (reference: src/ra.hrl:131-143)
+    next_index: int
+    last_index: int
+    last_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteRpc:
+    term: int
+    candidate_id: ServerId
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestVoteResult:
+    term: int
+    vote_granted: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class PreVoteRpc:
+    term: int
+    token: Any
+    candidate_id: ServerId
+    version: int  # protocol version
+    machine_version: int
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PreVoteResult:
+    term: int
+    token: Any
+    vote_granted: bool
+
+
+# chunk phases for snapshot transfer
+CHUNK_INIT = "init"  # first chunk of meta negotiation
+CHUNK_PRE = "pre"  # sparse live entries preceding the snapshot body
+CHUNK_NEXT = "next"
+CHUNK_LAST = "last"
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotRpc:
+    term: int
+    leader_id: ServerId
+    meta: SnapshotMeta
+    chunk_no: int
+    chunk_phase: str  # CHUNK_*
+    data: Any = b""
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotResult:
+    term: int
+    last_index: int
+    last_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HeartbeatRpc:
+    term: int
+    leader_id: ServerId
+    query_index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HeartbeatReply:
+    term: int
+    query_index: int
+
+
+# -- events delivered to the server core (non-peer messages) ---------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ElectionTimeout:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Tick:
+    now_ms: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class LogEvent:
+    """Event from the log/WAL subsystem (written confirmations etc.)."""
+
+    evt: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeEvent:
+    node: str
+    status: str  # "up" | "down"
+
+
+@dataclasses.dataclass(frozen=True)
+class DownEvent:
+    """A monitored process/actor went down."""
+
+    target: Any
+    info: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class FromPeer:
+    """Envelope: message `msg` received from peer `peer`."""
+
+    peer: ServerId
+    msg: Any

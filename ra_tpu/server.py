@@ -1,0 +1,1423 @@
+"""The pure consensus core — one Raft server's transition function.
+
+This is the framework's equivalent of the reference's ``ra_server``
+(reference: ``src/ra_server.erl:17-68`` — one ``handle_<role>`` per role,
+each returning ``(NextRole, State', Effects)``). The core performs **no
+I/O and no messaging**: it reads/writes its log only through the
+``LogApi`` facade, persists term/vote through ``MetaApi``, and returns
+``Effect`` values for the runtime to realise. That makes it:
+
+- exhaustively testable message-by-message (tests/test_server_*.py),
+- the *oracle* for the vectorized TPU kernels in ``ra_tpu.ops.consensus``
+  (both implement the decision math in ``ra_tpu.ops.decisions``).
+
+Roles: follower, pre_vote, candidate, leader, receive_snapshot,
+await_condition (reference: src/ra_server_proc.erl:20-32).
+
+Implementation style note: unlike the Erlang original this core mutates a
+``Server`` object in place — the purity that matters (no I/O, no time, no
+randomness, effects-as-data) is kept, while Python object churn is not,
+because the batch coordinator reads its state out as arrays anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ra_tpu import counters as ra_counters
+from ra_tpu.effects import (
+    Aux,
+    BgWork,
+    Checkpoint,
+    Demonitor,
+    Effect,
+    EffectList,
+    LogRead,
+    ModCall,
+    Monitor,
+    NextEvent,
+    Notify,
+    RecordLeader,
+    ReleaseCursor,
+    Reply,
+    SendMsg,
+    SendRpc,
+    SendSnapshot,
+    SendVoteRequests,
+    StateEnter,
+    Timer,
+)
+from ra_tpu.log.api import LogApi
+from ra_tpu.log.meta import MetaApi
+from ra_tpu.machine import Machine, normalize_apply_result
+from ra_tpu.ops import decisions as dec
+from ra_tpu.protocol import (
+    AppendEntriesReply,
+    AppendEntriesRpc,
+    CHUNK_INIT,
+    CHUNK_LAST,
+    CHUNK_NEXT,
+    CHUNK_PRE,
+    Command,
+    DownEvent,
+    ElectionTimeout,
+    Entry,
+    FromPeer,
+    HeartbeatReply,
+    HeartbeatRpc,
+    InstallSnapshotResult,
+    InstallSnapshotRpc,
+    LogEvent,
+    NOOP,
+    NodeEvent,
+    PreVoteResult,
+    PreVoteRpc,
+    RA_CLUSTER_CHANGE,
+    RA_JOIN,
+    RA_LEAVE,
+    RequestVoteResult,
+    RequestVoteRpc,
+    ServerId,
+    SnapshotMeta,
+    Tick,
+    USR,
+)
+
+PROTO_VERSION = 1
+
+FOLLOWER = "follower"
+PRE_VOTE = "pre_vote"
+CANDIDATE = "candidate"
+LEADER = "leader"
+RECEIVE_SNAPSHOT = "receive_snapshot"
+AWAIT_CONDITION = "await_condition"
+
+
+@dataclasses.dataclass
+class PeerState:
+    next_index: int = 1
+    match_index: int = 0
+    commit_index_sent: int = 0
+    query_index: int = 0
+    # "normal" | "sending_snapshot" | "suspended" | "disconnected"
+    status: str = "normal"
+    # "voter" | ("nonvoter", target_index) — nonvoters replicate but do
+    # not count for quorum/elections until promoted (reference:
+    # maybe_promote_peer src/ra_server.erl:3977-3995)
+    voter_status: Any = "voter"
+
+    def is_voter(self) -> bool:
+        return self.voter_status == "voter"
+
+
+@dataclasses.dataclass
+class TimeoutNow:
+    """Leadership-transfer trigger: target starts an election
+    immediately, skipping pre-vote (Raft §3.10)."""
+
+
+@dataclasses.dataclass
+class Condition:
+    predicate: Callable[["Server", Any], bool]
+    timeout_effects: Tuple[Effect, ...] = ()
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    server_id: ServerId
+    uid: str
+    cluster_name: str
+    machine: Machine
+    initial_members: Tuple[ServerId, ...] = ()
+    max_pipeline_count: int = 4096
+    max_aer_batch_size: int = 128
+    counters_enabled: bool = True
+    # pre_vote on by default; candidates skip straight to request_vote
+    # when False.
+    pre_vote: bool = True
+    machine_config: Optional[Dict[str, Any]] = None
+
+
+class Server:
+    """One Raft group member. See module docstring for the contract."""
+
+    def __init__(self, cfg: ServerConfig, log: LogApi, meta: MetaApi):
+        self.cfg = cfg
+        self.id: ServerId = cfg.server_id
+        self.log = log
+        self.meta = meta
+        self.machine = cfg.machine
+        self.role: str = FOLLOWER
+        self.leader_id: Optional[ServerId] = None
+
+        self.current_term: int = meta.fetch(cfg.uid, "current_term", 0)
+        self.voted_for: Optional[ServerId] = meta.fetch(cfg.uid, "voted_for", None)
+        self.commit_index: int = 0
+        self.last_applied: int = meta.fetch(cfg.uid, "last_applied", 0)
+
+        # machine versioning (reference: src/ra_server.erl:223-233)
+        self.machine_version: int = self.machine.version()
+        self.effective_machine_version: int = 0
+
+        # cluster membership
+        self.cluster: Dict[ServerId, PeerState] = {}
+        self.cluster_index_term: Tuple[int, int] = (0, 0)
+        self.previous_cluster: Optional[Tuple[int, int, Dict[ServerId, PeerState]]] = None
+        self.cluster_change_permitted: bool = False
+        self.pending_cluster_change: Optional[Tuple[Any, Any]] = None
+
+        # election state
+        self.votes: Set[ServerId] = set()
+        self.pre_votes: Set[ServerId] = set()
+        self.pre_vote_token: int = 0
+        self._token_counter: int = 0
+
+        # consistent-query state (leader side)
+        self.query_index: int = 0
+        self.pending_queries: List[Tuple[int, Any, Callable]] = []
+
+        # receive_snapshot state
+        self._snap_accept: Optional[Dict[str, Any]] = None
+
+        self.condition: Optional[Condition] = None
+
+        self.counter = (
+            ra_counters.new((cfg.cluster_name, cfg.server_id)) if cfg.counters_enabled else None
+        )
+
+        # machine state: from snapshot if present, else init
+        snap = log.read_snapshot()
+        if snap is not None:
+            meta_s, mac_state = snap
+            self.machine_state = mac_state
+            self.effective_machine_version = meta_s.machine_version
+            self._set_cluster(
+                {sid: PeerState() for sid in meta_s.cluster}, meta_s.index, meta_s.term
+            )
+            self.commit_index = meta_s.index
+            self.last_applied = max(self.last_applied, meta_s.index)
+        else:
+            self.machine_state = self.machine.init(
+                dict(cfg.machine_config or {}, name=cfg.cluster_name)
+            )
+            members = cfg.initial_members or (cfg.server_id,)
+            self._set_cluster({sid: PeerState() for sid in members}, 0, 0)
+
+    # ------------------------------------------------------------------
+    # helpers
+
+    def _c(self, field: str, n: int = 1) -> None:
+        if self.counter is not None:
+            self.counter.incr(field, n)
+
+    def _g(self, field: str, v: int) -> None:
+        if self.counter is not None:
+            self.counter.put(field, v)
+
+    def _set_cluster(self, cluster: Dict[ServerId, PeerState], idx: int, term: int) -> None:
+        self.cluster = cluster
+        self.cluster_index_term = (idx, term)
+        if self.id not in self.cluster:
+            # we may have been removed; keep a self entry for bookkeeping
+            self.cluster = dict(cluster)
+            self.cluster[self.id] = PeerState()
+
+    def members(self) -> List[ServerId]:
+        return list(self.cluster.keys())
+
+    def peers(self) -> Dict[ServerId, PeerState]:
+        return {sid: p for sid, p in self.cluster.items() if sid != self.id}
+
+    def voters(self) -> List[ServerId]:
+        return [sid for sid, p in self.cluster.items() if p.is_voter()]
+
+    def required_quorum(self) -> int:
+        return len(self.voters()) // 2 + 1
+
+    def is_voter_self(self) -> bool:
+        p = self.cluster.get(self.id)
+        return p is not None and p.is_voter()
+
+    def _new_token(self) -> int:
+        self._token_counter += 1
+        return self._token_counter
+
+    def _persist_term_vote(self) -> None:
+        self.meta.store_sync(self.cfg.uid, "current_term", self.current_term)
+        self.meta.store_sync(self.cfg.uid, "voted_for", self.voted_for)
+        self._g("term", self.current_term)
+
+    def _update_term(self, term: int, voted_for: Optional[ServerId] = None) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = voted_for
+            self._persist_term_vote()
+
+    def overview(self) -> Dict[str, Any]:
+        li, lt = self.log.last_index_term()
+        return {
+            "id": self.id,
+            "role": self.role,
+            "leader": self.leader_id,
+            "current_term": self.current_term,
+            "commit_index": self.commit_index,
+            "last_applied": self.last_applied,
+            "last_index": li,
+            "last_term": lt,
+            "cluster": {sid: dataclasses.asdict(p) for sid, p in self.cluster.items()},
+            "cluster_change_permitted": self.cluster_change_permitted,
+            "machine_version": self.machine_version,
+            "effective_machine_version": self.effective_machine_version,
+            "machine": self.machine.overview(self.machine_state),
+            "log": self.log.overview(),
+        }
+
+    # ------------------------------------------------------------------
+    # recovery
+
+    def recover(self) -> None:
+        """Replay the log up to the persisted last_applied, discarding
+        effects (reference: ra_server:recover/1 src/ra_server.erl:469-528;
+        effects are not re-issued after restart, INTERNALS.md:91-106)."""
+        snap = self.log.snapshot_index_term()
+        snap_idx = snap[0] if snap else 0
+        self._scan_cluster_changes(snap_idx + 1)
+        last_idx = self.log.last_index_term()[0]
+        target = min(max(self.commit_index, self.last_applied), last_idx)
+        # machine_state was recovered from the snapshot (or init): replay
+        # starts right above it regardless of the persisted watermark
+        self.last_applied = snap_idx
+        self.commit_index = max(target, snap_idx)
+        self._apply_to(self.commit_index, discard_effects=True)
+
+    def _scan_cluster_changes(self, from_idx: int) -> None:
+        last_idx, _ = self.log.last_index_term()
+
+        def scan(entry: Entry, acc: None) -> None:
+            cmd = entry.cmd
+            if isinstance(cmd, Command) and cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                self._apply_cluster_entry(entry)
+            return acc
+
+        if from_idx <= last_idx:
+            try:
+                self.log.fold(from_idx, last_idx, scan, None)
+            except KeyError:
+                pass  # sparse/compacted region: snapshot cluster stands
+
+    # ------------------------------------------------------------------
+    # dispatch
+
+    def handle(self, msg: Any, from_peer: Optional[ServerId] = None) -> EffectList:
+        if isinstance(msg, FromPeer):
+            return self.handle(msg.msg, from_peer=msg.peer)
+        handler = {
+            FOLLOWER: self._handle_follower,
+            PRE_VOTE: self._handle_pre_vote,
+            CANDIDATE: self._handle_candidate,
+            LEADER: self._handle_leader,
+            RECEIVE_SNAPSHOT: self._handle_receive_snapshot,
+            AWAIT_CONDITION: self._handle_await_condition,
+        }[self.role]
+        effects = handler(msg, from_peer)
+        self._g("commit_index", self.commit_index)
+        self._g("last_applied", self.last_applied)
+        return effects
+
+    # ------------------------------------------------------------------
+    # role transitions
+
+    def _become(self, role: str, effects: EffectList) -> None:
+        prev = self.role
+        self.role = role
+        if role == FOLLOWER:
+            self.votes = set()
+            self.pre_votes = set()
+        if prev != role:
+            effects.append(StateEnter(role))
+            effects.extend(self.machine.state_enter(role, self.machine_state))
+
+    def _become_leader(self, effects: EffectList) -> None:
+        self.leader_id = self.id
+        last_idx, _ = self.log.last_index_term()
+        for sid, p in self.cluster.items():
+            if sid != self.id:
+                p.next_index = last_idx + 1
+                p.match_index = 0
+                p.commit_index_sent = 0
+                p.status = "normal"
+        self.cluster_change_permitted = False
+        self.pending_cluster_change = None
+        self.query_index = 0
+        self.pending_queries = []
+        for p in self.cluster.values():
+            p.query_index = 0
+        self._become(LEADER, effects)
+        effects.append(
+            RecordLeader(self.cfg.cluster_name, self.id, tuple(self.members()))
+        )
+        # Append a noop for the new term; its commit re-enables cluster
+        # changes and (upgrade strategy permitting) bumps the machine
+        # version (reference: post_election_effects src/ra_server.erl:
+        # 4028-4064).
+        noop = Command(kind=NOOP, machine_version=max(self.machine_version,
+                                                     self.effective_machine_version))
+        self._append_leader(noop, effects)
+        self._pipeline(effects)
+
+    def _become_follower(self, effects: EffectList, leader: Optional[ServerId] = None) -> None:
+        if leader is not None and leader != self.leader_id:
+            self.leader_id = leader
+            effects.append(
+                RecordLeader(self.cfg.cluster_name, leader, tuple(self.members()))
+            )
+        self._become(FOLLOWER, effects)
+
+    # ------------------------------------------------------------------
+    # leader
+
+    def _handle_leader(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        effects: EffectList = []
+        if isinstance(msg, Command):
+            self._c("commands")
+            self._append_leader(msg, effects)
+            self._pipeline(effects)
+            return effects
+        if isinstance(msg, list):  # batched commands
+            self._c("commands", len(msg))
+            for cmd in msg:
+                self._append_leader(cmd, effects)
+            self._pipeline(effects)
+            return effects
+        if isinstance(msg, AppendEntriesReply):
+            return self._leader_aer_reply(msg, from_peer, effects)
+        if isinstance(msg, InstallSnapshotResult):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects, leader=None)
+                return effects
+            peer = self.cluster.get(from_peer)
+            if peer is not None:
+                peer.status = "normal"
+                peer.match_index = max(peer.match_index, msg.last_index)
+                peer.next_index = max(peer.next_index, msg.last_index + 1)
+                self._evaluate_quorum(effects)
+                self._pipeline(effects)
+            return effects
+        if isinstance(msg, RequestVoteRpc):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+                return effects
+            effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, False)))
+            return effects
+        if isinstance(msg, PreVoteRpc):
+            if msg.term > self.current_term:
+                # A higher term exists: abdicate and process as follower.
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                effects.append(
+                    SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, False))
+                )
+            return effects
+        if isinstance(msg, AppendEntriesRpc):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects, leader=from_peer)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                # two leaders in one term must not happen; tell them ours
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        AppendEntriesReply(
+                            self.current_term, False,
+                            next_index=self.log.next_index(),
+                            last_index=self.log.last_index_term()[0],
+                            last_term=self.log.last_index_term()[1],
+                        ),
+                    )
+                )
+            return effects
+        if isinstance(msg, HeartbeatReply):
+            peer = self.cluster.get(from_peer)
+            if peer is not None and msg.term == self.current_term:
+                peer.query_index = max(peer.query_index, msg.query_index)
+                self._evaluate_queries(effects)
+            elif msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+            return effects
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            self._evaluate_quorum(effects)
+            self._pipeline(effects)
+            return effects
+        if isinstance(msg, Tick):
+            return self._leader_tick(msg, effects)
+        if isinstance(msg, ElectionTimeout):
+            return effects  # leaders ignore election timeouts
+        if isinstance(msg, (NodeEvent, DownEvent)):
+            return self._leader_node_event(msg, effects)
+        if isinstance(msg, TimeoutNow):
+            return effects
+        # membership / control commands arrive as plain tuples
+        if isinstance(msg, tuple) and msg:
+            return self._leader_control(msg, effects)
+        return effects
+
+    def _append_leader(self, cmd: Command, effects: EffectList) -> None:
+        """Append a command to the leader's log, handling membership
+        commands and reply-after-append modes (reference:
+        append_log_leader src/ra_server.erl:3485-3550)."""
+        if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+            if not self._append_cluster_cmd(cmd, effects):
+                return
+        idx = self.log.next_index()
+        entry = Entry(index=idx, term=self.current_term, cmd=cmd)
+        self.log.append(entry)
+        self._g("last_index", idx)
+        if cmd.reply_mode == "after_log_append" and cmd.from_ref is not None:
+            effects.append(Reply(cmd.from_ref, ("ok", (idx, self.current_term), self.id)))
+
+    def _append_cluster_cmd(self, cmd: Command, effects: EffectList) -> bool:
+        """Returns False when the change must be rejected. Only one
+        in-flight cluster change is allowed (Raft one-at-a-time member
+        changes; reference: src/ra_server.erl:3491-3542)."""
+        if not self.cluster_change_permitted:
+            if cmd.from_ref is not None:
+                effects.append(
+                    Reply(cmd.from_ref, ("error", "cluster_change_not_permitted"))
+                )
+            return False
+        idx = self.log.next_index()
+        new_cluster = {sid: dataclasses.replace(p) for sid, p in self.cluster.items()}
+        if cmd.kind == RA_JOIN:
+            member, voter = cmd.data
+            if member in new_cluster:
+                if cmd.from_ref is not None:
+                    effects.append(Reply(cmd.from_ref, ("ok", "already_member")))
+                return False
+            ps = PeerState(next_index=self.log.next_index() + 1)
+            if not voter:
+                ps.voter_status = ("nonvoter", self.log.last_index_term()[0])
+            new_cluster[member] = ps
+        elif cmd.kind == RA_LEAVE:
+            member = cmd.data
+            if member not in new_cluster:
+                if cmd.from_ref is not None:
+                    effects.append(Reply(cmd.from_ref, ("ok", "not_member")))
+                return False
+            del new_cluster[member]
+        else:  # RA_CLUSTER_CHANGE: explicit voter-status updates
+            for member, voter_status in cmd.data:
+                if member in new_cluster:
+                    new_cluster[member].voter_status = voter_status
+        self.previous_cluster = (
+            self.cluster_index_term[0],
+            self.cluster_index_term[1],
+            self.cluster,
+        )
+        self._set_cluster(new_cluster, idx, self.current_term)
+        self.cluster_change_permitted = False
+        return True
+
+    def _leader_aer_reply(
+        self, msg: AppendEntriesReply, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        if msg.term > self.current_term:
+            self._update_term(msg.term)
+            self._become_follower(effects)
+            return effects
+        peer = self.cluster.get(from_peer)
+        if peer is None or msg.term < self.current_term:
+            return effects
+        if msg.success:
+            peer.match_index = max(peer.match_index, msg.last_index)
+            peer.next_index = max(peer.next_index, msg.last_index + 1)
+            if peer.status == "suspended":
+                peer.status = "normal"
+            self._maybe_promote_peer(from_peer, peer, effects)
+            self._evaluate_quorum(effects)
+        else:
+            self._c("aer_replies_failed")
+            # Stale-reply detection via last_index/last_term (reference
+            # relies on these reply fields, src/ra.hrl:131-143).
+            hint = max(1, msg.next_index)
+            peer.next_index = max(min(hint, msg.last_index + 1), peer.match_index + 1)
+        self._pipeline(effects)
+        return effects
+
+    def _maybe_promote_peer(self, sid: ServerId, peer: PeerState, effects: EffectList) -> None:
+        if (
+            isinstance(peer.voter_status, tuple)
+            and peer.voter_status[0] == "nonvoter"
+            and peer.match_index >= peer.voter_status[1]
+            and self.cluster_change_permitted
+        ):
+            cmd = Command(kind=RA_CLUSTER_CHANGE, data=((sid, "voter"),))
+            self._append_leader(cmd, effects)
+
+    def _evaluate_quorum(self, effects: EffectList) -> None:
+        """match_index -> commit_index quorum scan. The leader counts its
+        own durable (written) watermark, not its in-memory tail
+        (reference: evaluate_quorum/agreed_commit src/ra_server.erl:
+        3633-3688)."""
+        written_idx, _ = self.log.last_written()
+        self._g("last_written_index", written_idx)
+        match = []
+        for sid, p in self.cluster.items():
+            if not p.is_voter():
+                continue
+            match.append(written_idx if sid == self.id else p.match_index)
+        if not match:
+            return
+        agreed = dec.agreed_commit(match)
+        term_at = self.log.fetch_term(agreed)
+        new_ci = dec.new_commit_index(
+            match, self.commit_index, -1 if term_at is None else term_at, self.current_term
+        )
+        if new_ci > self.commit_index:
+            self.commit_index = new_ci
+            self._apply_to(new_ci, effects=effects)
+
+    def _evaluate_queries(self, effects: EffectList) -> None:
+        if not self.pending_queries:
+            return
+        qis = []
+        for sid, p in self.cluster.items():
+            if not p.is_voter():
+                continue
+            qis.append(self.query_index if sid == self.id else p.query_index)
+        agreed_qi = dec.agreed_commit(qis)
+        still = []
+        for qi, from_ref, fn in self.pending_queries:
+            if qi <= agreed_qi:
+                self._c("consistent_queries")
+                effects.append(Reply(from_ref, ("ok", fn(self.machine_state), self.id)))
+            else:
+                still.append((qi, from_ref, fn))
+        self.pending_queries = still
+
+    def _leader_control(self, msg: tuple, effects: EffectList) -> EffectList:
+        kind = msg[0]
+        if kind == "consistent_query":
+            _, fn, from_ref = msg
+            self.query_index += 1
+            self.pending_queries.append((self.query_index, from_ref, fn))
+            hb = HeartbeatRpc(self.current_term, self.id, self.query_index)
+            for sid, p in self.peers().items():
+                if p.is_voter():
+                    effects.append(SendRpc(sid, hb))
+            self._evaluate_queries(effects)  # single-node clusters
+            return effects
+        if kind == "transfer_leadership":
+            _, target, from_ref = msg
+            if target == self.id:
+                if from_ref is not None:
+                    effects.append(Reply(from_ref, ("ok", "already_leader")))
+                return effects
+            if target not in self.cluster:
+                if from_ref is not None:
+                    effects.append(Reply(from_ref, ("error", "unknown_member")))
+                return effects
+            effects.append(SendRpc(target, TimeoutNow()))
+            if from_ref is not None:
+                effects.append(Reply(from_ref, ("ok", None)))
+            return effects
+        if kind == "aux":
+            _, aux_kind, cmd, from_ref = msg
+            return self._handle_aux(aux_kind, cmd, from_ref, effects)
+        return effects
+
+    def _leader_tick(self, msg: Tick, effects: EffectList) -> EffectList:
+        # persist last_applied so effects are not re-issued on recovery
+        # (reference: persist_last_applied src/ra_server.erl:2540-2567)
+        self.meta.store(self.cfg.uid, "last_applied", self.last_applied)
+        effects.extend(self.machine.tick(msg.now_ms, self.machine_state))
+        self._pipeline(effects, force_commit_sync=True)
+        return effects
+
+    def _leader_node_event(self, msg: Any, effects: EffectList) -> EffectList:
+        if isinstance(msg, NodeEvent):
+            for sid, p in self.peers().items():
+                if sid[1] == msg.node:
+                    p.status = "disconnected" if msg.status == "down" else "normal"
+            data = ("nodeup", msg.node) if msg.status == "up" else ("nodedown", msg.node)
+            self._append_leader(Command(kind=USR, data=data), effects)
+        else:  # DownEvent
+            self._append_leader(
+                Command(kind=USR, data=("down", msg.target, msg.info)), effects
+            )
+        self._pipeline(effects)
+        return effects
+
+    def _pipeline(self, effects: EffectList, force_commit_sync: bool = False) -> None:
+        """Build pipelined AppendEntries for every peer (reference:
+        make_pipelined_rpc_effects src/ra_server.erl:2285-2434)."""
+        last_idx, _ = self.log.last_index_term()
+        for sid, peer in self.peers().items():
+            if peer.status in ("sending_snapshot", "suspended", "disconnected"):
+                continue
+            sent_any = False
+            while (
+                peer.next_index <= last_idx
+                and (peer.next_index - peer.match_index) <= self.cfg.max_pipeline_count
+            ):
+                if not self._send_aer(sid, peer, effects):
+                    break
+                sent_any = True
+            if not sent_any and (
+                peer.commit_index_sent < self.commit_index or force_commit_sync
+            ):
+                self._send_aer(sid, peer, effects, empty=True)
+
+    def _send_aer(
+        self, sid: ServerId, peer: PeerState, effects: EffectList, empty: bool = False
+    ) -> bool:
+        prev_idx = peer.next_index - 1
+        prev_term = self.log.fetch_term(prev_idx)
+        snap = self.log.snapshot_index_term()
+        if prev_term is None or (snap is not None and prev_idx < snap[0]):
+            # prev entry compacted away: peer needs a snapshot
+            # (reference: make_rpc_effect snapshot branch
+            # src/ra_server.erl:2392-2415)
+            peer.status = "sending_snapshot"
+            effects.append(SendSnapshot(sid, meta=self.log.snapshot_meta()))
+            return False
+        entries: Tuple[Entry, ...] = ()
+        if not empty:
+            last_idx, _ = self.log.last_index_term()
+            hi = min(last_idx, prev_idx + self.cfg.max_aer_batch_size)
+            if hi > prev_idx:
+                acc: List[Entry] = []
+                self.log.fold(prev_idx + 1, hi, lambda e, a: (a.append(e), a)[1], acc)
+                entries = tuple(acc)
+        rpc = AppendEntriesRpc(
+            term=self.current_term,
+            leader_id=self.id,
+            prev_log_index=prev_idx,
+            prev_log_term=prev_term,
+            leader_commit=self.commit_index,
+            entries=entries,
+        )
+        effects.append(SendRpc(sid, rpc))
+        self._c("msgs_sent")
+        peer.commit_index_sent = max(peer.commit_index_sent, self.commit_index)
+        if entries:
+            peer.next_index = entries[-1].index + 1
+        return bool(entries)
+
+    # ------------------------------------------------------------------
+    # apply loop
+
+    def _apply_to(
+        self, idx: int, effects: Optional[EffectList] = None, discard_effects: bool = False
+    ) -> None:
+        """Apply committed entries to the machine (reference: apply_to /
+        apply_with src/ra_server.erl:3244-3335)."""
+        sink: EffectList = [] if effects is None else effects
+        last_idx, _ = self.log.last_index_term()
+        hi = min(idx, last_idx)
+        if hi <= self.last_applied:
+            return
+        lo = self.last_applied + 1
+        notify: Dict[Any, List[Any]] = {}
+
+        def apply_one(entry: Entry, acc: None) -> None:
+            self._apply_entry(entry, sink if not discard_effects else [], notify,
+                              discard_effects)
+            return acc
+
+        self.log.fold(lo, hi, apply_one, None)
+        self.last_applied = hi
+        self._c("applied", hi - lo + 1)
+        if not discard_effects:
+            for who, corrs in notify.items():
+                sink.append(Notify(who, tuple(corrs)))
+            # machine-driven snapshot/checkpoint decisions ride on the
+            # release_cursor effects the machine returned (collected in
+            # _apply_entry); cluster-change commits unlock further changes
+        if self.commit_index >= self.cluster_index_term[0]:
+            self.cluster_change_permitted = self.role == LEADER
+        # promote pending nonvoters once changes are permitted again
+        if self.role == LEADER and self.cluster_change_permitted and not discard_effects:
+            for sid, p in list(self.peers().items()):
+                self._maybe_promote_peer(sid, p, sink)
+
+    def _apply_entry(
+        self,
+        entry: Entry,
+        effects: EffectList,
+        notify: Dict[Any, List[Any]],
+        discard: bool,
+    ) -> None:
+        cmd = entry.cmd
+        if not isinstance(cmd, Command):
+            return
+        is_leader = self.role == LEADER
+        if cmd.kind == USR:
+            meta = {
+                "index": entry.index,
+                "term": entry.term,
+                "machine_version": self.effective_machine_version,
+                "reply_mode": cmd.reply_mode,
+            }
+            mac = self.machine.which_module(self.effective_machine_version)
+            state, reply, mac_effects = normalize_apply_result(
+                mac.apply(meta, cmd.data, self.machine_state)
+            )
+            self.machine_state = state
+            mac_effects = self._realise_log_effects(entry, mac_effects)
+            if not discard:
+                # Client replies/notifications and most machine side
+                # effects are issued by the leader only; followers keep
+                # local-option sends (reference: effect filtering in
+                # ra_server_proc, "local" send_msg option).
+                if is_leader:
+                    effects.extend(mac_effects)
+                    self._reply_applied(entry, cmd, reply, effects, notify)
+                else:
+                    effects.extend(
+                        e for e in mac_effects
+                        if isinstance(e, SendMsg) and "local" in e.options
+                    )
+        elif cmd.kind == NOOP:
+            if cmd.machine_version > self.effective_machine_version:
+                old_v = self.effective_machine_version
+                self.effective_machine_version = cmd.machine_version
+                mac = self.machine.which_module(cmd.machine_version)
+                meta = {
+                    "index": entry.index,
+                    "term": entry.term,
+                    "machine_version": cmd.machine_version,
+                }
+                state, _reply, mac_effects = normalize_apply_result(
+                    mac.apply(meta, ("machine_version", old_v, cmd.machine_version),
+                              self.machine_state)
+                )
+                self.machine_state = state
+                if not discard and is_leader:
+                    effects.extend(mac_effects)
+            if not discard and is_leader:
+                self._reply_applied(entry, cmd, None, effects, notify)
+        elif cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+            if not discard and is_leader:
+                self._reply_applied(entry, cmd, None, effects, notify)
+
+    def _realise_log_effects(self, entry: Entry, mac_effects: List[Effect]) -> List[Effect]:
+        """Machines steer snapshotting via release_cursor / checkpoint
+        effects; the core realises those against its own log (reference:
+        update_release_cursor src/ra_server.erl:2455-2479) and passes the
+        rest through to the runtime."""
+        out: List[Effect] = []
+        for eff in mac_effects:
+            if isinstance(eff, ReleaseCursor):
+                self.log.update_release_cursor(
+                    eff.index,
+                    tuple(self.members()),
+                    self.effective_machine_version,
+                    eff.machine_state,
+                )
+                self._c("releases")
+            elif isinstance(eff, Checkpoint):
+                self.log.checkpoint(
+                    eff.index,
+                    tuple(self.members()),
+                    self.effective_machine_version,
+                    eff.machine_state,
+                )
+                self._c("checkpoints_written")
+            else:
+                out.append(eff)
+        return out
+
+    def _reply_applied(
+        self,
+        entry: Entry,
+        cmd: Command,
+        reply: Any,
+        effects: EffectList,
+        notify: Dict[Any, List[Any]],
+    ) -> None:
+        mode = cmd.reply_mode
+        if mode == "await_consensus" and cmd.from_ref is not None:
+            effects.append(
+                Reply(cmd.from_ref, ("ok", reply, self.id))
+            )
+        elif isinstance(mode, tuple) and mode and mode[0] == "notify":
+            _, corr, who = mode
+            notify.setdefault(who, []).append((corr, reply))
+
+    # ------------------------------------------------------------------
+    # follower
+
+    def _handle_follower(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        effects: EffectList = []
+        if isinstance(msg, AppendEntriesRpc):
+            return self._follower_aer(msg, from_peer, effects)
+        if isinstance(msg, RequestVoteRpc):
+            return self._follower_request_vote(msg, from_peer, effects)
+        if isinstance(msg, PreVoteRpc):
+            li, lt = self.log.last_index_term()
+            granted = dec.pre_vote_decision(
+                self.current_term,
+                msg.term,
+                msg.machine_version,
+                self.effective_machine_version,
+                msg.last_log_index,
+                msg.last_log_term,
+                li,
+                lt,
+            )
+            # a higher observed term still bumps ours (without vote)
+            self._update_term(msg.term)
+            effects.append(
+                SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
+            )
+            return effects
+        if isinstance(msg, InstallSnapshotRpc):
+            return self._follower_install_snapshot(msg, from_peer, effects)
+        if isinstance(msg, HeartbeatRpc):
+            if msg.term >= self.current_term:
+                self._update_term(msg.term)
+                self.leader_id = msg.leader_id
+                effects.append(
+                    SendRpc(from_peer, HeartbeatReply(self.current_term, msg.query_index))
+                )
+            else:
+                effects.append(
+                    SendRpc(from_peer, HeartbeatReply(self.current_term, 0))
+                )
+            return effects
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            self._follower_send_written_reply(effects)
+            self._apply_to(self.commit_index, effects=effects)
+            return effects
+        if isinstance(msg, ElectionTimeout):
+            return self._call_for_election_or_pre_vote(effects)
+        if isinstance(msg, TimeoutNow):
+            if self.is_voter_self():
+                self._c("force_elections")
+                self._call_for_election(effects)
+            return effects
+        if isinstance(msg, Tick):
+            self.meta.store(self.cfg.uid, "last_applied", self.last_applied)
+            effects.extend(self.machine.tick(msg.now_ms, self.machine_state))
+            return effects
+        if isinstance(msg, Command):
+            if msg.from_ref is not None:
+                effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
+            return effects
+        if isinstance(msg, (RequestVoteResult, PreVoteResult, AppendEntriesReply)):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+            return effects
+        if isinstance(msg, NodeEvent):
+            return effects
+        if isinstance(msg, tuple) and msg and msg[0] == "aux":
+            _, aux_kind, cmd, from_ref = msg
+            return self._handle_aux(aux_kind, cmd, from_ref, effects)
+        return effects
+
+    def _follower_aer(
+        self, msg: AppendEntriesRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        self._c("aer_received")
+        snap = self.log.snapshot_index_term()
+        snap_idx = snap[0] if snap else 0
+        local_prev_term = self.log.fetch_term(msg.prev_log_index)
+        code = dec.aer_decision(
+            self.current_term,
+            msg.term,
+            msg.prev_log_index,
+            msg.prev_log_term,
+            -1 if local_prev_term is None else local_prev_term,
+            snap_idx,
+        )
+        li, lt = self.log.last_index_term()
+        if code == dec.AER_STALE:
+            effects.append(
+                SendRpc(
+                    from_peer,
+                    AppendEntriesReply(self.current_term, False, li + 1, li, lt),
+                )
+            )
+            return effects
+        self._update_term(msg.term)
+        if self.leader_id != msg.leader_id:
+            self.leader_id = msg.leader_id
+            effects.append(
+                RecordLeader(self.cfg.cluster_name, self.leader_id, tuple(self.members()))
+            )
+        if code in (dec.AER_MISMATCH, dec.AER_BEHIND_SNAPSHOT):
+            self._c("aer_replies_failed")
+            nid = dec.aer_failure_next_index(self.commit_index, li, msg.prev_log_index, snap_idx)
+            effects.append(
+                SendRpc(
+                    from_peer,
+                    AppendEntriesReply(self.current_term, False, nid, li, lt),
+                )
+            )
+            return effects
+        # AER_OK: drop already-matching entries, truncate on divergence,
+        # write the rest (reference: drop_existing src/ra_server.erl:3700)
+        to_write: List[Entry] = []
+        for e in msg.entries:
+            if e.index <= li:
+                our_term = self.log.fetch_term(e.index)
+                if our_term == e.term:
+                    continue  # duplicate
+                to_write = [x for x in msg.entries if x.index >= e.index]
+                break
+            to_write.append(e)
+        last_entry_idx = msg.entries[-1].index if msg.entries else msg.prev_log_index
+        if to_write:
+            self.log.write(to_write)
+            li, lt = self.log.last_index_term()
+        self.commit_index = max(self.commit_index, min(msg.leader_commit, last_entry_idx))
+        # Reply only with the durable watermark; if writes are pending the
+        # reply happens on the written event (reference: src/ra_server.erl:
+        # 1457-1474 — replies carry the last fsynced index).
+        wi, wt = self.log.last_written()
+        if wi >= last_entry_idx or not to_write:
+            self._c("aer_replies_success")
+            effects.append(
+                SendRpc(
+                    from_peer,
+                    AppendEntriesReply(self.current_term, True, wi + 1, wi, wt),
+                )
+            )
+        # cluster changes take effect at append time
+        for e in to_write:
+            if isinstance(e.cmd, Command) and e.cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                self._apply_cluster_entry(e)
+        self._apply_to(self.commit_index, effects=effects)
+        return effects
+
+    def _apply_cluster_entry(self, entry: Entry) -> None:
+        cmd = entry.cmd
+        new_cluster = {sid: dataclasses.replace(p) for sid, p in self.cluster.items()}
+        if cmd.kind == RA_JOIN:
+            member, voter = cmd.data
+            if member not in new_cluster:
+                ps = PeerState()
+                if not voter:
+                    ps.voter_status = ("nonvoter", entry.index)
+                new_cluster[member] = ps
+        elif cmd.kind == RA_LEAVE:
+            new_cluster.pop(cmd.data, None)
+        else:
+            for member, voter_status in cmd.data:
+                if member in new_cluster:
+                    new_cluster[member].voter_status = voter_status
+        self.previous_cluster = (
+            self.cluster_index_term[0],
+            self.cluster_index_term[1],
+            self.cluster,
+        )
+        self._set_cluster(new_cluster, entry.index, entry.term)
+
+    def _follower_send_written_reply(self, effects: EffectList) -> None:
+        if self.leader_id is None or self.leader_id == self.id:
+            return
+        wi, wt = self.log.last_written()
+        self._c("aer_replies_success")
+        effects.append(
+            SendRpc(
+                self.leader_id,
+                AppendEntriesReply(self.current_term, True, wi + 1, wi, wt),
+            )
+        )
+
+    def _follower_request_vote(
+        self, msg: RequestVoteRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        li, lt = self.log.last_index_term()
+        voted_slot = -1
+        if self.voted_for is not None and msg.term == self.current_term:
+            voted_slot = 0 if self.voted_for == msg.candidate_id else 1
+        grant, new_term = dec.vote_decision(
+            self.current_term,
+            voted_slot if voted_slot >= 0 else -1,
+            0,
+            msg.term,
+            msg.last_log_index,
+            msg.last_log_term,
+            li,
+            lt,
+        )
+        if new_term > self.current_term:
+            self.current_term = new_term
+            self.voted_for = None
+        if grant:
+            self.voted_for = msg.candidate_id
+            self.leader_id = None
+        if new_term != self.meta.fetch(self.cfg.uid, "current_term", 0) or grant:
+            self._persist_term_vote()
+        effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, grant)))
+        return effects
+
+    def _follower_install_snapshot(
+        self, msg: InstallSnapshotRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        if msg.term < self.current_term:
+            li, lt = self.log.last_index_term()
+            effects.append(
+                SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
+            )
+            return effects
+        self._update_term(msg.term)
+        self.leader_id = msg.leader_id
+        self._snap_accept = {
+            "meta": msg.meta,
+            "chunks": [],
+            "next_chunk": 0,
+            "from": from_peer,
+        }
+        self._become(RECEIVE_SNAPSHOT, effects)
+        effects.append(NextEvent(FromPeer(from_peer, msg)))
+        return effects
+
+    def _call_for_election_or_pre_vote(self, effects: EffectList) -> EffectList:
+        if not self.is_voter_self():
+            return effects  # nonvoters never start elections
+        if self.cfg.pre_vote:
+            return self._call_for_pre_vote(effects)
+        return self._call_for_election(effects)
+
+    def _call_for_pre_vote(self, effects: EffectList) -> EffectList:
+        self._c("pre_vote_elections")
+        self.pre_vote_token = self._new_token()
+        self.pre_votes = {self.id}
+        self.leader_id = None
+        self._become(PRE_VOTE, effects)
+        if len(self.voters()) == 1 and self.is_voter_self():
+            return self._call_for_election(effects)
+        li, lt = self.log.last_index_term()
+        rpc = PreVoteRpc(
+            term=self.current_term,
+            token=self.pre_vote_token,
+            candidate_id=self.id,
+            version=PROTO_VERSION,
+            machine_version=self.machine_version,
+            last_log_index=li,
+            last_log_term=lt,
+        )
+        reqs = tuple(
+            (sid, rpc) for sid, p in self.peers().items() if p.is_voter()
+        )
+        effects.append(SendVoteRequests(reqs))
+        return effects
+
+    def _call_for_election(self, effects: EffectList) -> EffectList:
+        self._c("elections")
+        self.current_term += 1
+        self.voted_for = self.id
+        self._persist_term_vote()
+        self.votes = {self.id}
+        self.leader_id = None
+        self._become(CANDIDATE, effects)
+        if len(self.voters()) == 1 and self.is_voter_self():
+            self._become_leader(effects)
+            return effects
+        li, lt = self.log.last_index_term()
+        rpc = RequestVoteRpc(
+            term=self.current_term, candidate_id=self.id, last_log_index=li, last_log_term=lt
+        )
+        reqs = tuple((sid, rpc) for sid, p in self.peers().items() if p.is_voter())
+        effects.append(SendVoteRequests(reqs))
+        return effects
+
+    # ------------------------------------------------------------------
+    # pre_vote role
+
+    def _handle_pre_vote(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        effects: EffectList = []
+        if isinstance(msg, PreVoteResult):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                return effects
+            if msg.token != self.pre_vote_token or not msg.vote_granted:
+                return effects
+            if from_peer is not None:
+                self.pre_votes.add(from_peer)
+            if len(self.pre_votes) >= self.required_quorum():
+                self._call_for_election(effects)
+            return effects
+        if isinstance(msg, AppendEntriesRpc):
+            if msg.term >= self.current_term:
+                self._become_follower(effects, leader=msg.leader_id)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                li, lt = self.log.last_index_term()
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        AppendEntriesReply(self.current_term, False, li + 1, li, lt),
+                    )
+                )
+            return effects
+        if isinstance(msg, (RequestVoteRpc, InstallSnapshotRpc)):
+            self._become_follower(effects)
+            effects.append(NextEvent(FromPeer(from_peer, msg)))
+            return effects
+        if isinstance(msg, PreVoteRpc):
+            # competing pre-vote: grant by the same rules as a follower
+            granted = dec.pre_vote_decision(
+                self.current_term,
+                msg.term,
+                msg.machine_version,
+                self.effective_machine_version,
+                msg.last_log_index,
+                msg.last_log_term,
+                *self.log.last_index_term(),
+            )
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+            effects.append(
+                SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
+            )
+            return effects
+        if isinstance(msg, ElectionTimeout):
+            return self._call_for_pre_vote(effects)
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            return effects
+        if isinstance(msg, Command):
+            if msg.from_ref is not None:
+                effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
+            return effects
+        return effects
+
+    # ------------------------------------------------------------------
+    # candidate role
+
+    def _handle_candidate(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        effects: EffectList = []
+        if isinstance(msg, RequestVoteResult):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                return effects
+            if msg.term < self.current_term or not msg.vote_granted:
+                return effects
+            if from_peer is not None:
+                self.votes.add(from_peer)
+            if len(self.votes) >= self.required_quorum():
+                self._become_leader(effects)
+            return effects
+        if isinstance(msg, AppendEntriesRpc):
+            if msg.term >= self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects, leader=msg.leader_id)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                li, lt = self.log.last_index_term()
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        AppendEntriesReply(self.current_term, False, li + 1, li, lt),
+                    )
+                )
+            return effects
+        if isinstance(msg, RequestVoteRpc):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, False)))
+            return effects
+        if isinstance(msg, PreVoteRpc):
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._become_follower(effects)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                effects.append(
+                    SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, False))
+                )
+            return effects
+        if isinstance(msg, ElectionTimeout):
+            return self._call_for_election(effects)
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            return effects
+        if isinstance(msg, Command):
+            if msg.from_ref is not None:
+                effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
+            return effects
+        return effects
+
+    # ------------------------------------------------------------------
+    # receive_snapshot role
+
+    def _handle_receive_snapshot(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        """Four-phase chunked snapshot install: init -> pre (sparse live
+        entries) -> next* -> last (reference: handle_receive_snapshot
+        src/ra_server.erl:1659-1807)."""
+        effects: EffectList = []
+        if isinstance(msg, InstallSnapshotRpc):
+            if msg.term < self.current_term:
+                li, lt = self.log.last_index_term()
+                effects.append(
+                    SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
+                )
+                return effects
+            acc = self._snap_accept
+            if acc is None or acc["meta"].index != msg.meta.index:
+                acc = {"meta": msg.meta, "chunks": [], "next_chunk": 0, "from": from_peer}
+                self._snap_accept = acc
+            if msg.chunk_phase == CHUNK_INIT:
+                acc["next_chunk"] = 1
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
+                    )
+                )
+                return effects
+            if msg.chunk_phase == CHUNK_PRE:
+                # sparse live entries preceding the snapshot body
+                entries = msg.data
+                for e in entries:
+                    if self.log.fetch_term(e.index) is None:
+                        self._write_sparse(e)
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
+                    )
+                )
+                return effects
+            # next / last
+            acc["chunks"].append(msg.data)
+            acc["next_chunk"] += 1
+            if msg.chunk_phase == CHUNK_LAST:
+                return self._complete_snapshot(msg, from_peer, effects)
+            effects.append(
+                SendRpc(
+                    from_peer,
+                    InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
+                )
+            )
+            return effects
+        if isinstance(msg, ElectionTimeout):
+            self._snap_accept = None
+            self._become_follower(effects)
+            return effects
+        if isinstance(msg, AppendEntriesRpc) and msg.term >= self.current_term:
+            # leader moved on; abandon the transfer
+            self._snap_accept = None
+            self._become_follower(effects, leader=msg.leader_id)
+            effects.append(NextEvent(FromPeer(from_peer, msg)))
+            return effects
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            return effects
+        if isinstance(msg, Command):
+            if msg.from_ref is not None:
+                effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
+            return effects
+        return effects
+
+    def _write_sparse(self, entry: Entry) -> None:
+        # live entries may be non-contiguous; MemoryLog tolerates direct
+        # injection, the real log has a dedicated sparse write path
+        writer = getattr(self.log, "write_sparse", None)
+        if writer is not None:
+            writer(entry)
+        else:
+            self.log.entries[entry.index] = entry  # type: ignore[attr-defined]
+
+    def _complete_snapshot(
+        self, msg: InstallSnapshotRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        acc = self._snap_accept
+        assert acc is not None
+        chunks = acc["chunks"]
+        machine_state = self._decode_snapshot(chunks)
+        old_meta = self.log.snapshot_meta()
+        old_state = self.machine_state
+        self.log.install_snapshot(msg.meta, machine_state)
+        self.machine_state = machine_state
+        self.effective_machine_version = msg.meta.machine_version
+        self.commit_index = max(self.commit_index, msg.meta.index)
+        self.last_applied = max(self.last_applied, msg.meta.index)
+        self._set_cluster(
+            {sid: PeerState() for sid in msg.meta.cluster}, msg.meta.index, msg.meta.term
+        )
+        self._c("snapshot_installed")
+        self._g("snapshot_index", msg.meta.index)
+        effects.extend(
+            self.machine.snapshot_installed(msg.meta, machine_state, old_meta, old_state)
+        )
+        self._snap_accept = None
+        self._become_follower(effects, leader=msg.leader_id)
+        effects.append(
+            SendRpc(
+                from_peer,
+                InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
+            )
+        )
+        return effects
+
+    @staticmethod
+    def _decode_snapshot(chunks: List[Any]) -> Any:
+        if len(chunks) == 1 and not isinstance(chunks[0], (bytes, bytearray)):
+            return chunks[0]  # in-proc transfer: machine state shipped direct
+        import pickle
+
+        return pickle.loads(b"".join(chunks))
+
+    # ------------------------------------------------------------------
+    # await_condition role
+
+    def _handle_await_condition(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
+        effects: EffectList = []
+        cond = self.condition
+        if isinstance(msg, ElectionTimeout):  # doubles as condition timeout
+            self.condition = None
+            self._become_follower(effects)
+            if cond is not None:
+                effects.extend(cond.timeout_effects)
+            return effects
+        if cond is not None and cond.predicate(self, msg):
+            self.condition = None
+            self._become_follower(effects)
+            effects.append(NextEvent(FromPeer(from_peer, msg) if from_peer else msg))
+            return effects
+        if isinstance(msg, LogEvent):
+            self.log.handle_event(msg.evt)
+            return effects
+        return effects
+
+    def await_condition(self, cond: Condition, effects: EffectList) -> None:
+        self.condition = cond
+        self._become(AWAIT_CONDITION, effects)
+
+    # ------------------------------------------------------------------
+    # aux machine plumbing
+
+    def _handle_aux(self, kind: str, cmd: Any, from_ref: Any, effects: EffectList) -> EffectList:
+        if not hasattr(self, "aux_state"):
+            self.aux_state = self.machine.init_aux(self.cfg.cluster_name)
+        res = self.machine.handle_aux(self.role, kind, cmd, self.aux_state, self)
+        if res is None:
+            return effects
+        if len(res) == 2:
+            reply, self.aux_state = res
+            aux_effects: List[Effect] = []
+        else:
+            reply, self.aux_state, aux_effects = res
+        effects.extend(aux_effects)
+        if kind == "call" and from_ref is not None:
+            effects.append(Reply(from_ref, ("ok", reply, self.id)))
+        return effects

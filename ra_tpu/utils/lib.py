@@ -12,8 +12,8 @@ import os
 import secrets
 import string
 import tempfile
+import threading
 import time
-from concurrent.futures import FIRST_COMPLETED, Future, ThreadPoolExecutor, wait as fut_wait
 from typing import Callable, Iterable, List, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
@@ -98,30 +98,41 @@ def partition_parallel(
     errs: List[Tuple[T, BaseException]] = []
     if not items:
         return oks, errs
-    ex = ThreadPoolExecutor(max_workers=min(max_workers, len(items)))
-    try:
-        futs: dict[Future, T] = {ex.submit(fn, item): item for item in items}
-        deadline = time.monotonic() + timeout_s
-        pending = set(futs)
-        while pending:
-            done, pending = fut_wait(
-                pending, timeout=max(0.0, deadline - time.monotonic()), return_when=FIRST_COMPLETED
-            )
-            for fut in done:
-                item = futs[fut]
-                try:
-                    oks.append((item, fut.result()))
-                except BaseException as e:  # noqa: BLE001
-                    errs.append((item, e))
-            if not done and time.monotonic() >= deadline:
-                for fut in pending:
-                    fut.cancel()
-                    errs.append((futs[fut], TimeoutError(f"timed out after {timeout_s}s")))
+    # Daemon threads, not ThreadPoolExecutor: hung tasks must neither block
+    # this call past the deadline nor pin interpreter exit (non-daemon pool
+    # workers are joined at shutdown).
+    results: dict[int, Tuple[str, Any]] = {}
+    lock = threading.Lock()
+    done_cv = threading.Condition(lock)
+    sem = threading.Semaphore(min(max_workers, len(items)))
+
+    def run(i: int, item: T) -> None:
+        with sem:
+            try:
+                r: Tuple[str, Any] = ("ok", fn(item))
+            except BaseException as e:  # noqa: BLE001
+                r = ("err", e)
+        with done_cv:
+            results[i] = r
+            done_cv.notify_all()
+
+    for i, item in enumerate(items):
+        threading.Thread(target=run, args=(i, item), daemon=True).start()
+    deadline = time.monotonic() + timeout_s
+    with done_cv:
+        while len(results) < len(items):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not done_cv.wait(timeout=remaining):
                 break
-    finally:
-        # Don't block on hung workers: overall wall time is bounded by the
-        # deadline above even if a task never returns.
-        ex.shutdown(wait=False)
+        snapshot = dict(results)
+    for i, item in enumerate(items):
+        res = snapshot.get(i)
+        if res is None:
+            errs.append((item, TimeoutError(f"timed out after {timeout_s}s")))
+        elif res[0] == "ok":
+            oks.append((item, res[1]))
+        else:
+            errs.append((item, res[1]))
     return oks, errs
 
 

@@ -12,6 +12,7 @@ wants ascending order.
 
 from __future__ import annotations
 
+import bisect
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 Range = Tuple[int, int]
@@ -73,8 +74,6 @@ class Seq:
         return sum(hi - lo + 1 for lo, hi in self._ranges)
 
     def __contains__(self, idx: int) -> bool:
-        import bisect
-
         i = bisect.bisect_right(self._ranges, (idx, float("inf"))) - 1
         if i < 0:
             return False

@@ -1,0 +1,168 @@
+"""In-test cluster harness: routes effects between Server cores.
+
+The scenario tests drive pure `Server` objects message-by-message; this
+Net routes SendRpc/SendVoteRequests/NextEvent effects as an in-memory
+"network" with partition and drop support — the same trick the reference
+uses to run "multi-node" Raft clusters inside one runtime
+(reference: docs/internals/INTERNALS.md:174-177, test/ra_server_SUITE.erl).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ra_tpu.effects import (
+    NextEvent,
+    Notify,
+    RecordLeader,
+    Reply,
+    SendRpc,
+    SendSnapshot,
+    SendVoteRequests,
+    StateEnter,
+)
+from ra_tpu.log.memory import MemoryLog
+from ra_tpu.log.meta import InMemoryMeta
+from ra_tpu.protocol import (
+    Command,
+    ElectionTimeout,
+    FromPeer,
+    LogEvent,
+    ServerId,
+    USR,
+)
+from ra_tpu.server import LEADER, Server, ServerConfig
+
+
+def make_server(
+    sid: ServerId,
+    members,
+    machine,
+    auto_written: bool = True,
+    meta: Optional[InMemoryMeta] = None,
+    log: Optional[MemoryLog] = None,
+) -> Server:
+    cfg = ServerConfig(
+        server_id=sid,
+        uid=f"uid_{sid[0]}",
+        cluster_name="c1",
+        machine=machine,
+        initial_members=tuple(members),
+        counters_enabled=False,
+    )
+    return Server(cfg, log or MemoryLog(auto_written=auto_written), meta or InMemoryMeta())
+
+
+class Net:
+    def __init__(self, servers: Dict[ServerId, Server], auto_written: bool = True):
+        self.servers = servers
+        self.auto_written = auto_written
+        self.queue: deque = deque()  # (to, from_peer, msg)
+        self.replies: List[Tuple[Any, Any]] = []
+        self.notifications: List[Notify] = []
+        self.leader_records: List[RecordLeader] = []
+        self.snapshot_requests: List[Tuple[ServerId, ServerId]] = []  # (from, to)
+        self.blocked: set = set()  # directed (a, b) pairs that drop msgs
+        self._written_seen: Dict[ServerId, int] = {sid: 0 for sid in servers}
+
+    # -- partitions --------------------------------------------------------
+
+    def partition(self, a: ServerId, b: ServerId) -> None:
+        self.blocked.add((a, b))
+        self.blocked.add((b, a))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+    # -- delivery ----------------------------------------------------------
+
+    def send(self, to: ServerId, msg: Any, from_peer: Optional[ServerId] = None) -> None:
+        self.queue.append((to, from_peer, msg))
+
+    def deliver(self, to: ServerId, msg: Any, from_peer: Optional[ServerId] = None) -> None:
+        srv = self.servers[to]
+        effects = srv.handle(msg, from_peer=from_peer)
+        self._process_effects(to, effects)
+        self._maybe_written(to)
+
+    def _maybe_written(self, sid: ServerId) -> None:
+        srv = self.servers[sid]
+        if self.auto_written:
+            wi = srv.log.last_written()[0]
+            if wi > self._written_seen[sid] and srv.role == LEADER:
+                self._written_seen[sid] = wi
+                self.send(sid, LogEvent(("written", srv.log.last_written()[1], None)))
+            else:
+                self._written_seen[sid] = max(self._written_seen[sid], wi)
+
+    def pump_written(self, sid: ServerId) -> None:
+        """Manual durability mode: deliver pending written events."""
+        srv = self.servers[sid]
+        for evt in srv.log.pending_written_events():  # type: ignore[attr-defined]
+            self.send(sid, LogEvent(evt))
+
+    def _process_effects(self, origin: ServerId, effects) -> None:
+        for eff in effects:
+            if isinstance(eff, SendRpc):
+                if (origin, eff.to) not in self.blocked and eff.to in self.servers:
+                    self.send(eff.to, eff.msg, from_peer=origin)
+            elif isinstance(eff, SendVoteRequests):
+                for to, rpc in eff.requests:
+                    if (origin, to) not in self.blocked and to in self.servers:
+                        self.send(to, rpc, from_peer=origin)
+            elif isinstance(eff, NextEvent):
+                m = eff.msg
+                if isinstance(m, FromPeer):
+                    self.send(origin, m.msg, from_peer=m.peer)
+                else:
+                    self.send(origin, m)
+            elif isinstance(eff, Reply):
+                self.replies.append((eff.from_ref, eff.reply))
+            elif isinstance(eff, Notify):
+                self.notifications.append(eff)
+            elif isinstance(eff, RecordLeader):
+                self.leader_records.append(eff)
+            elif isinstance(eff, SendSnapshot):
+                self.snapshot_requests.append((origin, eff.to))
+            elif isinstance(eff, StateEnter):
+                pass
+
+    def run(self, max_steps: int = 10_000) -> None:
+        steps = 0
+        while self.queue:
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("message storm: no quiescence")
+            to, from_peer, msg = self.queue.popleft()
+            self.deliver(to, msg, from_peer=from_peer)
+
+    # -- conveniences ------------------------------------------------------
+
+    def elect(self, sid: ServerId) -> None:
+        self.deliver(sid, ElectionTimeout())
+        self.run()
+        assert self.servers[sid].role == LEADER, self.servers[sid].role
+
+    def leader(self) -> Optional[ServerId]:
+        for sid, s in self.servers.items():
+            if s.role == LEADER:
+                return sid
+        return None
+
+    def command(
+        self, to: ServerId, data: Any, reply_mode: Any = "await_consensus", from_ref: Any = None
+    ) -> None:
+        self.deliver(
+            to,
+            Command(kind=USR, data=data, reply_mode=reply_mode, from_ref=from_ref),
+        )
+        self.run()
+
+
+def three_node_net(machine_factory: Callable[[], Any], auto_written: bool = True) -> Net:
+    ids = [("s1", "nodeA"), ("s2", "nodeB"), ("s3", "nodeC")]
+    servers = {
+        sid: make_server(sid, ids, machine_factory(), auto_written=auto_written) for sid in ids
+    }
+    return Net(servers, auto_written=auto_written)
