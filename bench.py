@@ -1,0 +1,94 @@
+"""Benchmark: vectorized multi-raft consensus decision throughput.
+
+Measures the TPU hot path of the framework — the fused per-group
+consensus decision step (AppendEntries accept + vote grant + match_index
+quorum commit scan) over BASELINE.json's headline configuration of
+10k raft groups x 3 replicas — and prints ONE JSON line.
+
+The reference publishes no benchmark numbers (BASELINE.md: published={}).
+``vs_baseline`` therefore compares against the reference harness's
+*driver target rate* of 100,000 ops/sec (reference: src/ra_bench.erl:38,
+the only quantitative throughput anchor the reference ships): the number
+of consensus decisions/sec the device path sustains divided by 100k.
+This is the decision-kernel ceiling, not yet end-to-end commands/sec;
+the full-pipeline bench lands with the batch coordinator backend.
+
+Usage: python bench.py [--smoke]
+"""
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small/fast run")
+    ap.add_argument("--groups", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ra_tpu.ops.consensus import (
+        MSG_AER,
+        consensus_step_impl,
+        empty_mailbox,
+        make_group_state,
+    )
+
+    G = args.groups or (1024 if args.smoke else 10240)
+    T = args.steps or (10 if args.smoke else 200)
+    P = 3
+
+    state = make_group_state(G, P)
+    mbox = empty_mailbox(G)._replace(
+        msg_type=jnp.full((G,), MSG_AER, jnp.int32),
+        term=jnp.ones((G,), jnp.int32),
+        prev_idx=jnp.zeros((G,), jnp.int32),
+        prev_term=jnp.zeros((G,), jnp.int32),
+        num_entries=jnp.ones((G,), jnp.int32),
+        entries_last_term=jnp.ones((G,), jnp.int32),
+        leader_commit=jnp.zeros((G,), jnp.int32),
+    )
+
+    def many_steps(state, mbox):
+        def body(st, _):
+            # sustained append load: every step carries one new entry per
+            # group, prev-matched against the current tail, so the ring
+            # buffer, tail bookkeeping and accept path all do real work
+            mb = mbox._replace(prev_idx=st.last_index, prev_term=st.last_term)
+            st2, eg = consensus_step_impl(st, mb)
+            return st2, eg.success.sum()
+
+        st, sums = jax.lax.scan(body, state, None, length=T)
+        return st, sums
+
+    run = jax.jit(many_steps, donate_argnums=(0,))
+    # warmup/compile
+    st, sums = run(jax.tree.map(jnp.copy, state), mbox)
+    jax.block_until_ready(sums)
+
+    t0 = time.perf_counter()
+    st, sums = run(jax.tree.map(jnp.copy, state), mbox)
+    jax.block_until_ready(sums)
+    dt = time.perf_counter() - t0
+
+    decisions_per_sec = (G * T) / dt
+    print(
+        json.dumps(
+            {
+                "metric": "consensus decisions/sec (fused AER-accept + vote + "
+                f"quorum-scan step, {G} groups x {P} replicas, device "
+                f"{jax.devices()[0].platform})",
+                "value": round(decisions_per_sec, 1),
+                "unit": "decisions/sec",
+                "vs_baseline": round(decisions_per_sec / 100_000.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

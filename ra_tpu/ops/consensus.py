@@ -1,0 +1,473 @@
+"""Vectorized consensus kernels over a raft-group batch axis.
+
+The TPU execution backend for the consensus decision hot path: per-group
+scalar state lives in HBM as int32 structure-of-arrays indexed by
+group-id, and the three north-star decisions run as one fused, jitted
+step over *all* groups at once:
+
+- AppendEntries accept (term/prev-log matching) — mirrors
+  ``decisions.aer_decision`` (reference behavior: src/ra_server.erl
+  handle_follower :1283-1429);
+- RequestVote / PreVote grant — mirrors ``decisions.vote_decision`` /
+  ``decisions.pre_vote_decision`` (reference: :1489-1529, :2926-2984);
+- match_index -> commit_index quorum scan — mirrors
+  ``decisions.agreed_commit`` (reference: :3633-3688).
+
+Log *contents* stay host-side; the device keeps a ring-buffer window of
+recent entry terms (``term_suffix``, indexed by ``idx % K``) so prev-term
+matching and commit-term gating run without host round-trips. Groups
+whose lookup falls outside the window raise a ``needs_host`` flag and are
+resolved by the scalar oracle on the host (rare: deep backfill).
+
+TPU-first design notes:
+- everything is fixed-shape int32/bool; no data-dependent control flow —
+  each step processes "at most one message per group" mailboxes, masked
+  by ``msg_type``;
+- the group axis is embarrassingly parallel: shard it over a
+  ``jax.sharding.Mesh`` axis ("groups") and every kernel runs without
+  collectives; only host ingress/egress crosses the boundary;
+- P (replica slots) is a small static width; quorum scan is a sort along
+  that axis (lane-local, VPU-friendly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# message type tags for the per-group mailbox
+MSG_NONE = 0
+MSG_AER = 1  # AppendEntries request (follower path)
+MSG_AER_REPLY = 2  # AppendEntries reply (leader path)
+MSG_VOTE_REQ = 3
+MSG_VOTE_REPLY = 4
+MSG_PREVOTE_REQ = 5
+MSG_PREVOTE_REPLY = 6
+
+# roles
+R_FOLLOWER = 0
+R_PRE_VOTE = 1
+R_CANDIDATE = 2
+R_LEADER = 3
+
+# AER decision codes (must match ra_tpu.ops.decisions)
+AER_STALE = 0
+AER_OK = 1
+AER_MISMATCH = 2
+AER_BEHIND_SNAPSHOT = 3
+
+
+class GroupState(NamedTuple):
+    """Per-group consensus state, shape [G] or [G, P]. ``self_slot`` is
+    this coordinator's slot in each group's member table."""
+
+    current_term: jax.Array  # i32[G]
+    voted_for: jax.Array  # i32[G], peer slot or -1
+    commit_index: jax.Array  # i32[G]
+    last_applied: jax.Array  # i32[G]
+    last_index: jax.Array  # i32[G] last visible log index
+    last_term: jax.Array  # i32[G]
+    written_index: jax.Array  # i32[G] durable watermark
+    snapshot_index: jax.Array  # i32[G]
+    snapshot_term: jax.Array  # i32[G]
+    role: jax.Array  # i32[G]
+    leader_slot: jax.Array  # i32[G], -1 unknown
+    self_slot: jax.Array  # i32[G]
+    machine_version: jax.Array  # i32[G] effective machine version
+    match_index: jax.Array  # i32[G, P]
+    next_index: jax.Array  # i32[G, P]
+    voting: jax.Array  # bool[G, P]
+    active: jax.Array  # bool[G, P]
+    votes: jax.Array  # bool[G, P]
+    pre_votes: jax.Array  # bool[G, P]
+    term_suffix: jax.Array  # i32[G, K] ring buffer of entry terms
+
+
+class Mailbox(NamedTuple):
+    """At most one inbound message per group per step (dense)."""
+
+    msg_type: jax.Array  # i32[G]
+    sender_slot: jax.Array  # i32[G]
+    term: jax.Array  # i32[G]
+    # AER request fields
+    prev_idx: jax.Array  # i32[G]
+    prev_term: jax.Array  # i32[G]
+    num_entries: jax.Array  # i32[G]
+    entries_last_term: jax.Array  # i32[G] term of last entry in the batch
+    leader_commit: jax.Array  # i32[G]
+    # reply fields (AER reply) / vote fields
+    success: jax.Array  # bool[G] (AER reply / vote granted)
+    reply_next_idx: jax.Array  # i32[G]
+    reply_last_idx: jax.Array  # i32[G]
+    reply_last_term: jax.Array  # i32[G]
+    cand_last_idx: jax.Array  # i32[G]
+    cand_last_term: jax.Array  # i32[G]
+    cand_machine_version: jax.Array  # i32[G]
+
+
+class Egress(NamedTuple):
+    """Per-group outbound decision for the host to serialize."""
+
+    send_reply: jax.Array  # bool[G] reply to sender?
+    reply_type: jax.Array  # i32[G] echoes request type
+    reply_to: jax.Array  # i32[G] sender slot
+    term: jax.Array  # i32[G]
+    success: jax.Array  # bool[G]
+    next_index: jax.Array  # i32[G]
+    last_index: jax.Array  # i32[G]
+    last_term: jax.Array  # i32[G]
+    aer_code: jax.Array  # i32[G] accept decision (write entries iff OK)
+    became_leader: jax.Array  # bool[G]
+    became_candidate: jax.Array  # bool[G]
+    commit_advanced_to: jax.Array  # i32[G] new commit index (== old if not)
+    needs_host: jax.Array  # bool[G] fall back to scalar oracle
+    term_or_vote_changed: jax.Array  # bool[G] host must persist term/vote
+
+
+def make_group_state(num_groups: int, num_peers: int, suffix_k: int = 32) -> GroupState:
+    g, p, k = num_groups, num_peers, suffix_k
+    zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)  # noqa: E731
+    zb = lambda *s: jnp.zeros(s, dtype=jnp.bool_)  # noqa: E731
+    return GroupState(
+        current_term=zi(g),
+        voted_for=jnp.full((g,), -1, jnp.int32),
+        commit_index=zi(g),
+        last_applied=zi(g),
+        last_index=zi(g),
+        last_term=zi(g),
+        written_index=zi(g),
+        snapshot_index=zi(g),
+        snapshot_term=zi(g),
+        role=zi(g),
+        leader_slot=jnp.full((g,), -1, jnp.int32),
+        self_slot=zi(g),
+        machine_version=zi(g),
+        match_index=zi(g, p),
+        next_index=jnp.ones((g, p), jnp.int32),
+        voting=jnp.ones((g, p), jnp.bool_),
+        active=jnp.ones((g, p), jnp.bool_),
+        votes=zb(g, p),
+        pre_votes=zb(g, p),
+        term_suffix=zi(g, k),
+    )
+
+
+def empty_mailbox(num_groups: int) -> Mailbox:
+    g = num_groups
+    zi = lambda: jnp.zeros((g,), jnp.int32)  # noqa: E731
+    return Mailbox(
+        msg_type=zi(),
+        sender_slot=zi(),
+        term=zi(),
+        prev_idx=zi(),
+        prev_term=zi(),
+        num_entries=zi(),
+        entries_last_term=zi(),
+        leader_commit=zi(),
+        success=jnp.zeros((g,), jnp.bool_),
+        reply_next_idx=zi(),
+        reply_last_idx=zi(),
+        reply_last_term=zi(),
+        cand_last_idx=zi(),
+        cand_last_term=zi(),
+        cand_machine_version=zi(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-side term lookup
+
+
+def term_at(state: GroupState, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(term, known) — term of the entry at ``idx`` from the ring-buffer
+    window / snapshot boundary. known=False → host fallback needed."""
+    k = state.term_suffix.shape[-1]
+    in_window = (idx > jnp.maximum(state.last_index - k, state.snapshot_index)) & (
+        idx <= state.last_index
+    )
+    ring = jnp.take_along_axis(
+        state.term_suffix, (idx % k)[..., None], axis=-1
+    ).squeeze(-1)
+    is_snap = idx == state.snapshot_index
+    is_zero = idx <= 0
+    term = jnp.where(is_zero, 0, jnp.where(is_snap, state.snapshot_term, ring))
+    known = is_zero | is_snap | in_window
+    return term.astype(jnp.int32), known
+
+
+def _log_up_to_date(our_idx, our_term, cand_idx, cand_term):
+    return (cand_term > our_term) | ((cand_term == our_term) & (cand_idx >= our_idx))
+
+
+# ---------------------------------------------------------------------------
+# the fused step
+
+
+def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, Egress]:
+    """One decision step over all groups: classify at most one inbound
+    message per group, update consensus bookkeeping, run the quorum scan.
+    Pure function of (state, mailbox) — host performs all I/O."""
+    G, P = state.match_index.shape
+    gids = jnp.arange(G)
+
+    is_aer = mbox.msg_type == MSG_AER
+    is_aer_reply = mbox.msg_type == MSG_AER_REPLY
+    is_vote_req = mbox.msg_type == MSG_VOTE_REQ
+    is_vote_reply = mbox.msg_type == MSG_VOTE_REPLY
+    is_prevote_req = mbox.msg_type == MSG_PREVOTE_REQ
+    is_prevote_reply = mbox.msg_type == MSG_PREVOTE_REPLY
+    has_msg = mbox.msg_type != MSG_NONE
+
+    term0 = state.current_term
+    voted0 = state.voted_for
+    role0 = state.role
+
+    # -- universal higher-term handling (pre-vote requests excluded: they
+    #    probe without dethroning; pre-vote *replies* carry real terms)
+    bumps_term = has_msg & ~is_prevote_req & (mbox.term > term0)
+    term1 = jnp.where(bumps_term, mbox.term, term0)
+    voted1 = jnp.where(bumps_term, -1, voted0)
+    role1 = jnp.where(bumps_term, R_FOLLOWER, role0)
+    leader1 = jnp.where(bumps_term, -1, state.leader_slot)
+
+    # ---------------- AER (follower accept path) ----------------
+    local_prev_term, prev_known = term_at(state, mbox.prev_idx)
+    aer_stale = mbox.term < term1
+    aer_behind = mbox.prev_idx < state.snapshot_index
+    aer_match = prev_known & (local_prev_term == mbox.prev_term)
+    aer_code = jnp.where(
+        aer_stale,
+        AER_STALE,
+        jnp.where(
+            aer_behind,
+            AER_BEHIND_SNAPSHOT,
+            jnp.where(aer_match, AER_OK, AER_MISMATCH),
+        ),
+    ).astype(jnp.int32)
+    aer_ok = is_aer & (aer_code == AER_OK)
+    aer_fail_next = jnp.where(
+        aer_behind,
+        state.snapshot_index + 1,
+        jnp.where(
+            state.last_index < mbox.prev_idx,
+            state.last_index + 1,
+            state.commit_index + 1,
+        ),
+    )
+    # host fallback when prev-term unknown on device (deep backfill)
+    aer_needs_host = is_aer & ~aer_stale & ~aer_behind & ~prev_known
+
+    # accepting an AER names the sender leader and becomes follower
+    role2 = jnp.where(aer_ok, R_FOLLOWER, role1)
+    leader2 = jnp.where(aer_ok, mbox.sender_slot, leader1)
+
+    # log tail bookkeeping for accepted entries (host writes the bytes;
+    # device tracks the resulting tail). Overwrite of a divergent suffix
+    # rewinds last_index to prev+n.
+    new_last = mbox.prev_idx + mbox.num_entries
+    takes_entries = aer_ok & (mbox.num_entries > 0)
+    last_index2 = jnp.where(takes_entries, new_last, state.last_index)
+    last_term2 = jnp.where(takes_entries, mbox.entries_last_term, state.last_term)
+    # record the accepted tail term in the ring so back-to-back device
+    # steps can prev-match without host reconciliation (exact for the
+    # batch's last entry; the host's record_appended covers the rest of
+    # a multi-entry batch)
+    kk = state.term_suffix.shape[-1]
+    tail_slot = (new_last % kk)[:, None]
+    term_suffix2 = jnp.where(
+        (jnp.arange(kk)[None, :] == tail_slot) & takes_entries[:, None],
+        mbox.entries_last_term[:, None],
+        state.term_suffix,
+    )
+    # followers' commit index: min(leader_commit, last entry index)
+    commit2 = jnp.where(
+        aer_ok,
+        jnp.maximum(state.commit_index, jnp.minimum(mbox.leader_commit, new_last)),
+        state.commit_index,
+    )
+
+    # ---------------- votes ----------------
+    fresh_term = mbox.term > term0
+    free_to_vote = fresh_term | (voted1 == -1) | (voted1 == mbox.sender_slot)
+    up_to_date = _log_up_to_date(
+        last_index2, last_term2, mbox.cand_last_idx, mbox.cand_last_term
+    )
+    vote_grant = is_vote_req & (mbox.term >= term1) & free_to_vote & up_to_date
+    voted2 = jnp.where(vote_grant, mbox.sender_slot, voted1)
+    leader3 = jnp.where(vote_grant, -1, leader2)
+
+    prevote_grant = (
+        is_prevote_req
+        & (mbox.term >= term1)
+        & (mbox.cand_machine_version >= state.machine_version)
+        & up_to_date
+    )
+
+    # ---------------- vote replies (candidate/pre_vote path) ----------------
+    count_vote = is_vote_reply & (role1 == R_CANDIDATE) & mbox.success & (mbox.term == term1)
+    votes2 = jnp.where(
+        (count_vote[:, None] & (jnp.arange(P)[None, :] == mbox.sender_slot[:, None]))
+        | state.votes,
+        True,
+        False,
+    )
+    votes2 = jnp.where(role1[:, None] == R_CANDIDATE, votes2, False)
+    count_prevote = (
+        is_prevote_reply & (role1 == R_PRE_VOTE) & mbox.success & (mbox.term <= term1)
+    )
+    pre_votes2 = jnp.where(
+        (count_prevote[:, None] & (jnp.arange(P)[None, :] == mbox.sender_slot[:, None]))
+        | state.pre_votes,
+        True,
+        False,
+    )
+    pre_votes2 = jnp.where(role1[:, None] == R_PRE_VOTE, pre_votes2, False)
+
+    n_voters = jnp.sum(state.voting & state.active, axis=-1)
+    quorum = n_voters // 2 + 1
+    self_vote = jnp.take_along_axis(
+        state.voting & state.active, state.self_slot[:, None], axis=-1
+    ).squeeze(-1)
+    n_votes = jnp.sum(votes2 & state.voting & state.active, axis=-1) + jnp.where(
+        self_vote & (role1 == R_CANDIDATE), 1, 0
+    )
+    n_prevotes = jnp.sum(pre_votes2 & state.voting & state.active, axis=-1) + jnp.where(
+        self_vote & (role1 == R_PRE_VOTE), 1, 0
+    )
+    became_leader = (role1 == R_CANDIDATE) & (n_votes >= quorum)
+    became_candidate = (role1 == R_PRE_VOTE) & (n_prevotes >= quorum)
+
+    role3 = jnp.where(became_leader, R_LEADER, role2)
+    role3 = jnp.where(became_candidate, R_CANDIDATE, role3)
+    # candidate promotion bumps the term and votes for self
+    term2 = jnp.where(became_candidate, term1 + 1, term1)
+    voted3 = jnp.where(became_candidate, state.self_slot, voted2)
+    leader4 = jnp.where(became_leader, state.self_slot, leader3)
+    votes3 = jnp.where(became_candidate[:, None], False, votes2)
+    pre_votes3 = jnp.where(became_candidate[:, None], False, pre_votes2)
+
+    # new leader resets peer bookkeeping
+    match2 = jnp.where(became_leader[:, None], 0, state.match_index)
+    next2 = jnp.where(
+        became_leader[:, None], (last_index2 + 1)[:, None], state.next_index
+    )
+
+    # ---------------- AER replies (leader path) ----------------
+    lead_ok = is_aer_reply & (role3 == R_LEADER) & (mbox.term == term2)
+    sender_onehot = jnp.arange(P)[None, :] == mbox.sender_slot[:, None]
+    succ = (lead_ok & mbox.success)[:, None] & sender_onehot
+    match3 = jnp.where(succ, jnp.maximum(match2, mbox.reply_last_idx[:, None]), match2)
+    next3 = jnp.where(
+        succ, jnp.maximum(next2, mbox.reply_last_idx[:, None] + 1), next2
+    )
+    fail = (lead_ok & ~mbox.success)[:, None] & sender_onehot
+    fail_hint = jnp.maximum(
+        jnp.minimum(mbox.reply_next_idx, mbox.reply_last_idx + 1)[:, None], match3 + 1
+    )
+    next4 = jnp.where(fail, jnp.maximum(fail_hint, 1), next3)
+
+    # ---------------- quorum commit scan (leaders, every step) ----------------
+    is_self = jnp.arange(P)[None, :] == state.self_slot[:, None]
+    eff_match = jnp.where(is_self, state.written_index[:, None], match3)
+    eff_match = jnp.where(state.voting & state.active, eff_match, -1)
+    srt = jnp.sort(eff_match, axis=-1)  # ascending; non-voters (-1) first
+    pos = jnp.clip(P - 1 - n_voters // 2, 0, P - 1)
+    agreed = jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
+    agreed_term, agreed_known = term_at(
+        state._replace(
+            last_index=last_index2, last_term=last_term2, term_suffix=term_suffix2
+        ),
+        agreed,
+    )
+    can_commit = (
+        (role3 == R_LEADER)
+        & (agreed > commit2)
+        & agreed_known
+        & (agreed_term == term2)
+    )
+    commit3 = jnp.where(can_commit, agreed, commit2)
+    quorum_needs_host = (role3 == R_LEADER) & (agreed > commit2) & ~agreed_known
+
+    # ---------------- egress ----------------
+    reply_success = jnp.where(
+        is_aer,
+        aer_code == AER_OK,
+        jnp.where(is_vote_req, vote_grant, jnp.where(is_prevote_req, prevote_grant, False)),
+    )
+    # AER success replies report the durable watermark (host may defer the
+    # actual send until fsync when entries were written)
+    wi = jnp.where(aer_ok, state.written_index, last_index2)
+    reply_next = jnp.where(
+        is_aer & (aer_code != AER_OK), aer_fail_next, wi + 1
+    )
+    egress = Egress(
+        # a needs_host AER is resolved entirely by the host oracle — the
+        # device must not also emit its (bogus) mismatch rejection
+        send_reply=has_msg & ((is_aer & ~aer_needs_host) | is_vote_req | is_prevote_req),
+        reply_type=mbox.msg_type,
+        reply_to=mbox.sender_slot,
+        term=term2,
+        success=reply_success,
+        next_index=reply_next,
+        last_index=jnp.where(is_aer & aer_ok, wi, last_index2),
+        last_term=last_term2,
+        aer_code=jnp.where(is_aer, aer_code, -1),
+        became_leader=became_leader,
+        became_candidate=became_candidate,
+        commit_advanced_to=commit3,
+        needs_host=aer_needs_host | quorum_needs_host,
+        term_or_vote_changed=(term2 != term0) | (voted3 != voted0),
+    )
+    new_state = state._replace(
+        current_term=term2,
+        voted_for=voted3,
+        commit_index=commit3,
+        last_index=last_index2,
+        last_term=last_term2,
+        role=role3,
+        leader_slot=leader4,
+        match_index=match3,
+        next_index=next4,
+        votes=votes3,
+        pre_votes=pre_votes3,
+        term_suffix=term_suffix2,
+    )
+    return new_state, egress
+
+
+# The production entry point: jitted with the state buffers donated so the
+# G-sized arrays update in place in HBM.
+consensus_step = jax.jit(consensus_step_impl, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# host-side helpers for log-tail maintenance
+
+
+@jax.jit
+def record_appended(
+    state: GroupState, group_ids: jax.Array, idxs: jax.Array, terms: jax.Array
+) -> GroupState:
+    """Record host-appended entries (scatter into the term ring buffer and
+    advance the tails of the named groups). A batch may carry several
+    entries for one group; (group, idx) pairs must be unique."""
+    k = state.term_suffix.shape[-1]
+    ts = state.term_suffix.at[group_ids, idxs % k].set(terms)
+    # .max is order-independent under duplicate group indices...
+    last_index = state.last_index.at[group_ids].max(idxs)
+    # ...and last_term is then read back from the ring at the new tail
+    # (a duplicate-index .set of terms would have implementation-defined
+    # order for multi-entry batches spanning a term change)
+    touched = jnp.zeros_like(state.last_index, dtype=jnp.bool_).at[group_ids].set(True)
+    ring_at_tail = jnp.take_along_axis(ts, (last_index % k)[:, None], axis=-1).squeeze(-1)
+    last_term = jnp.where(touched, ring_at_tail, state.last_term)
+    return state._replace(term_suffix=ts, last_index=last_index, last_term=last_term)
+
+
+@jax.jit
+def record_written(state: GroupState, group_ids: jax.Array, idxs: jax.Array) -> GroupState:
+    """Advance durable watermarks after WAL fsync."""
+    return state._replace(written_index=state.written_index.at[group_ids].max(idxs))

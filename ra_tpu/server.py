@@ -414,16 +414,7 @@ class Server:
             effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, False)))
             return effects
         if isinstance(msg, PreVoteRpc):
-            if msg.term > self.current_term:
-                # A higher term exists: abdicate and process as follower.
-                self._update_term(msg.term)
-                self._become_follower(effects)
-                effects.append(NextEvent(FromPeer(from_peer, msg)))
-            else:
-                effects.append(
-                    SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, False))
-                )
-            return effects
+            return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, AppendEntriesRpc):
             if msg.term > self.current_term:
                 self._update_term(msg.term)
@@ -863,23 +854,7 @@ class Server:
         if isinstance(msg, RequestVoteRpc):
             return self._follower_request_vote(msg, from_peer, effects)
         if isinstance(msg, PreVoteRpc):
-            li, lt = self.log.last_index_term()
-            granted = dec.pre_vote_decision(
-                self.current_term,
-                msg.term,
-                msg.machine_version,
-                self.effective_machine_version,
-                msg.last_log_index,
-                msg.last_log_term,
-                li,
-                lt,
-            )
-            # a higher observed term still bumps ours (without vote)
-            self._update_term(msg.term)
-            effects.append(
-                SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
-            )
-            return effects
+            return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, InstallSnapshotRpc):
             return self._follower_install_snapshot(msg, from_peer, effects)
         if isinstance(msg, HeartbeatRpc):
@@ -1084,6 +1059,29 @@ class Server:
         effects.append(NextEvent(FromPeer(from_peer, msg)))
         return effects
 
+    def _process_pre_vote(
+        self, msg: PreVoteRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        """Pre-vote grant, identical in every role (reference keeps one
+        process_pre_vote for all roles too: src/ra_server.erl:2926-2984).
+        Pre-vote is non-disruptive: no term change, no abdication — a
+        genuinely ahead candidate dethrones us with its request_vote."""
+        li, lt = self.log.last_index_term()
+        granted = dec.pre_vote_decision(
+            self.current_term,
+            msg.term,
+            msg.machine_version,
+            self.effective_machine_version,
+            msg.last_log_index,
+            msg.last_log_term,
+            li,
+            lt,
+        )
+        effects.append(
+            SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
+        )
+        return effects
+
     def _call_for_election_or_pre_vote(self, effects: EffectList) -> EffectList:
         if not self.is_voter_self():
             return effects  # nonvoters never start elections
@@ -1169,22 +1167,7 @@ class Server:
             effects.append(NextEvent(FromPeer(from_peer, msg)))
             return effects
         if isinstance(msg, PreVoteRpc):
-            # competing pre-vote: grant by the same rules as a follower
-            granted = dec.pre_vote_decision(
-                self.current_term,
-                msg.term,
-                msg.machine_version,
-                self.effective_machine_version,
-                msg.last_log_index,
-                msg.last_log_term,
-                *self.log.last_index_term(),
-            )
-            if msg.term > self.current_term:
-                self._update_term(msg.term)
-            effects.append(
-                SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
-            )
-            return effects
+            return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, ElectionTimeout):
             return self._call_for_pre_vote(effects)
         if isinstance(msg, LogEvent):
@@ -1236,15 +1219,7 @@ class Server:
                 effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, False)))
             return effects
         if isinstance(msg, PreVoteRpc):
-            if msg.term > self.current_term:
-                self._update_term(msg.term)
-                self._become_follower(effects)
-                effects.append(NextEvent(FromPeer(from_peer, msg)))
-            else:
-                effects.append(
-                    SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, False))
-                )
-            return effects
+            return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, ElectionTimeout):
             return self._call_for_election(effects)
         if isinstance(msg, LogEvent):

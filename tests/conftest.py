@@ -1,12 +1,20 @@
 """Test harness config.
 
 Forces JAX onto an 8-device virtual CPU platform so multi-chip sharding
-paths are exercised without TPU hardware. Must run before jax imports.
+paths are exercised without TPU hardware. The axon TPU plugin (baked into
+the image via sitecustomize) forces ``jax_platforms=axon``, so an env var
+alone is not enough — we override the jax config after import, before any
+backend initializes. Keeps tests off the (single, tunnel-attached) TPU
+chip entirely.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

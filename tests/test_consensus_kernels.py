@@ -1,0 +1,375 @@
+"""Parity: vectorized consensus kernels vs the scalar oracle decisions.
+
+Random per-group states and mailboxes are classified by both
+``ra_tpu.ops.decisions`` (scalar spec, same math the Server core runs)
+and ``ra_tpu.ops.consensus.consensus_step`` (vectorized device path);
+every decision output must agree, group for group. Also checks that
+sharding the group axis over an 8-device mesh changes nothing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ra_tpu.ops import decisions as dec
+from ra_tpu.ops.consensus import (
+    AER_OK,
+    Egress,
+    GroupState,
+    Mailbox,
+    MSG_AER,
+    MSG_AER_REPLY,
+    MSG_NONE,
+    MSG_PREVOTE_REQ,
+    MSG_VOTE_REQ,
+    R_FOLLOWER,
+    R_LEADER,
+    consensus_step,
+    empty_mailbox,
+    make_group_state,
+    record_appended,
+    record_written,
+    term_at,
+)
+
+G, PEERS, K = 256, 5, 16
+
+
+def random_state(rng, g=G, p=PEERS, k=K):
+    """Random but internally consistent group states."""
+    st = make_group_state(g, p, k)
+    snapshot_index = rng.integers(0, 20, g)
+    tail_len = rng.integers(0, k - 1, g)  # keep within window
+    last_index = snapshot_index + tail_len
+    # terms ascending along the log
+    suffix = np.zeros((g, k), np.int32)
+    last_term = np.zeros(g, np.int32)
+    snap_term = rng.integers(0, 3, g)
+    for i in range(g):
+        t = snap_term[i]
+        for idx in range(snapshot_index[i] + 1, last_index[i] + 1):
+            if rng.random() < 0.3:
+                t += rng.integers(0, 2)
+            suffix[i, idx % k] = t
+        last_term[i] = t if tail_len[i] > 0 else snap_term[i]
+    current_term = last_term + rng.integers(0, 3, g)
+    commit = np.minimum(rng.integers(0, 40, g), last_index)
+    written = np.clip(last_index - rng.integers(0, 3, g), 0, None)
+    role = rng.integers(0, 4, g)
+    voting = rng.random((g, p)) < 0.8
+    self_slot = rng.integers(0, p, g)
+    for i in range(g):
+        voting[i, self_slot[i]] = True  # self is always a voter here
+    match = np.minimum(rng.integers(0, 50, (g, p)), last_index[:, None])
+    return st._replace(
+        current_term=jnp.asarray(current_term, jnp.int32),
+        voted_for=jnp.asarray(rng.integers(-1, p, g), jnp.int32),
+        commit_index=jnp.asarray(commit, jnp.int32),
+        last_index=jnp.asarray(last_index, jnp.int32),
+        last_term=jnp.asarray(last_term, jnp.int32),
+        written_index=jnp.asarray(written, jnp.int32),
+        snapshot_index=jnp.asarray(snapshot_index, jnp.int32),
+        snapshot_term=jnp.asarray(snap_term, jnp.int32),
+        role=jnp.asarray(role, jnp.int32),
+        self_slot=jnp.asarray(self_slot, jnp.int32),
+        machine_version=jnp.asarray(rng.integers(0, 3, g), jnp.int32),
+        match_index=jnp.asarray(match, jnp.int32),
+        voting=jnp.asarray(voting),
+        term_suffix=jnp.asarray(suffix),
+    )
+
+
+def scalar_term_at(st, i, idx):
+    """Scalar model of the device term lookup."""
+    idx = int(idx)
+    if idx <= 0:
+        return 0, True
+    if idx == int(st.snapshot_index[i]):
+        return int(st.snapshot_term[i]), True
+    k = st.term_suffix.shape[-1]
+    if int(st.last_index[i]) - k < idx <= int(st.last_index[i]) and idx > int(
+        st.snapshot_index[i]
+    ):
+        return int(st.term_suffix[i, idx % k]), True
+    return -1, False
+
+
+def test_term_at_matches_scalar_model():
+    rng = np.random.default_rng(0)
+    st = random_state(rng)
+    idxs = rng.integers(0, 40, G)
+    terms, known = term_at(st, jnp.asarray(idxs, jnp.int32))
+    for i in range(G):
+        t, kn = scalar_term_at(st, i, idxs[i])
+        assert bool(known[i]) == kn, i
+        if kn:
+            assert int(terms[i]) == t, i
+
+
+def test_aer_decision_parity():
+    rng = np.random.default_rng(1)
+    st = random_state(rng)
+    mbox = empty_mailbox(G)
+    prev_idx = rng.integers(0, 40, G)
+    prev_term = rng.integers(0, 6, G)
+    rpc_term = rng.integers(0, 8, G)
+    nent = rng.integers(0, 5, G)
+    mbox = mbox._replace(
+        msg_type=jnp.full((G,), MSG_AER, jnp.int32),
+        sender_slot=jnp.asarray(rng.integers(0, PEERS, G), jnp.int32),
+        term=jnp.asarray(rpc_term, jnp.int32),
+        prev_idx=jnp.asarray(prev_idx, jnp.int32),
+        prev_term=jnp.asarray(prev_term, jnp.int32),
+        num_entries=jnp.asarray(nent, jnp.int32),
+        entries_last_term=jnp.asarray(rpc_term, jnp.int32),
+        leader_commit=jnp.asarray(rng.integers(0, 50, G), jnp.int32),
+    )
+    new_st, eg = consensus_step(random_state(rng2 := np.random.default_rng(1)), mbox)
+    st = random_state(np.random.default_rng(1))  # fresh copy (donated arg)
+    for i in range(G):
+        cur = max(int(st.current_term[i]), int(rpc_term[i]))  # after bump
+        local_prev, known = scalar_term_at(st, i, prev_idx[i])
+        if not known:
+            if int(rpc_term[i]) >= int(st.current_term[i]) and prev_idx[i] >= int(
+                st.snapshot_index[i]
+            ):
+                assert bool(eg.needs_host[i])
+            continue
+        code = dec.aer_decision(
+            cur if int(rpc_term[i]) > int(st.current_term[i]) else int(st.current_term[i]),
+            int(rpc_term[i]),
+            int(prev_idx[i]),
+            int(prev_term[i]),
+            local_prev if known else -1,
+            int(st.snapshot_index[i]),
+        )
+        assert int(eg.aer_code[i]) == code, (
+            i, code, int(eg.aer_code[i]), int(st.current_term[i]), int(rpc_term[i]),
+        )
+        if code == dec.AER_MISMATCH or code == dec.AER_BEHIND_SNAPSHOT:
+            want = dec.aer_failure_next_index(
+                int(st.commit_index[i]), int(st.last_index[i]), int(prev_idx[i]),
+                int(st.snapshot_index[i]),
+            )
+            assert int(eg.next_index[i]) == want, i
+        if code == dec.AER_OK:
+            new_last = int(prev_idx[i]) + int(nent[i])
+            want_commit = max(
+                int(st.commit_index[i]), min(int(mbox.leader_commit[i]), new_last)
+            )
+            assert int(new_st.commit_index[i]) == want_commit, i
+            assert int(new_st.leader_slot[i]) == int(mbox.sender_slot[i])
+            assert int(new_st.role[i]) == R_FOLLOWER
+
+
+def _as_followers(st):
+    # pin roles so no group self-elects mid-step (single-voter groups in
+    # pre_vote/candidate roles legitimately bump their own terms)
+    return st._replace(role=jnp.zeros_like(st.role))
+
+
+def test_vote_decision_parity():
+    rng = np.random.default_rng(2)
+    st0 = _as_followers(random_state(rng))
+    mbox = empty_mailbox(G)
+    rpc_term = rng.integers(0, 8, G)
+    cand = rng.integers(0, PEERS, G)
+    cli = rng.integers(0, 40, G)
+    clt = rng.integers(0, 6, G)
+    mbox = mbox._replace(
+        msg_type=jnp.full((G,), MSG_VOTE_REQ, jnp.int32),
+        sender_slot=jnp.asarray(cand, jnp.int32),
+        term=jnp.asarray(rpc_term, jnp.int32),
+        cand_last_idx=jnp.asarray(cli, jnp.int32),
+        cand_last_term=jnp.asarray(clt, jnp.int32),
+    )
+    new_st, eg = consensus_step(_as_followers(random_state(np.random.default_rng(2))), mbox)
+    for i in range(G):
+        grant, new_term = dec.vote_decision(
+            int(st0.current_term[i]),
+            int(st0.voted_for[i]),
+            int(cand[i]),
+            int(rpc_term[i]),
+            int(cli[i]),
+            int(clt[i]),
+            int(st0.last_index[i]),
+            int(st0.last_term[i]),
+        )
+        assert bool(eg.success[i]) == grant, i
+        assert int(new_st.current_term[i]) == new_term, i
+        if grant:
+            assert int(new_st.voted_for[i]) == int(cand[i]), i
+
+
+def test_pre_vote_decision_parity():
+    rng = np.random.default_rng(3)
+    st0 = _as_followers(random_state(rng))
+    mbox = empty_mailbox(G)
+    rpc_term = rng.integers(0, 8, G)
+    mv = rng.integers(0, 4, G)
+    cli = rng.integers(0, 40, G)
+    clt = rng.integers(0, 6, G)
+    mbox = mbox._replace(
+        msg_type=jnp.full((G,), MSG_PREVOTE_REQ, jnp.int32),
+        sender_slot=jnp.asarray(rng.integers(0, PEERS, G), jnp.int32),
+        term=jnp.asarray(rpc_term, jnp.int32),
+        cand_machine_version=jnp.asarray(mv, jnp.int32),
+        cand_last_idx=jnp.asarray(cli, jnp.int32),
+        cand_last_term=jnp.asarray(clt, jnp.int32),
+    )
+    new_st, eg = consensus_step(_as_followers(random_state(np.random.default_rng(3))), mbox)
+    for i in range(G):
+        grant = dec.pre_vote_decision(
+            int(st0.current_term[i]),
+            int(rpc_term[i]),
+            int(mv[i]),
+            int(st0.machine_version[i]),
+            int(cli[i]),
+            int(clt[i]),
+            int(st0.last_index[i]),
+            int(st0.last_term[i]),
+        )
+        assert bool(eg.success[i]) == grant, i
+        # pre-vote requests never change our term
+        assert int(new_st.current_term[i]) == int(st0.current_term[i]), i
+
+
+def test_quorum_scan_parity():
+    rng = np.random.default_rng(4)
+    st0 = random_state(rng)
+    # all leaders, no inbound messages: the step is purely the commit scan
+    st0 = st0._replace(role=jnp.full((G,), R_LEADER, jnp.int32))
+    # consensus_step donates its input state: hand it a private copy
+    st_in = jax.tree.map(jnp.copy, st0)
+    new_st, eg = consensus_step(st_in, empty_mailbox(G))
+    for i in range(G):
+        match = []
+        for s in range(PEERS):
+            if not bool(st0.voting[i, s]):
+                continue
+            if s == int(st0.self_slot[i]):
+                match.append(int(st0.written_index[i]))
+            else:
+                match.append(int(st0.match_index[i, s]))
+        agreed = dec.agreed_commit(match)
+        t, known = scalar_term_at(st0, i, agreed)
+        if not known:
+            if agreed > int(st0.commit_index[i]):
+                assert bool(eg.needs_host[i]), i
+            continue
+        want = dec.new_commit_index(
+            match, int(st0.commit_index[i]), t, int(st0.current_term[i])
+        )
+        assert int(new_st.commit_index[i]) == want, (i, match, agreed, t)
+
+
+def test_leader_aer_reply_updates_match_and_commit():
+    st = make_group_state(4, 3, K)
+    # group 0: leader at term 2 with 3 entries in term 2, self slot 0
+    st = st._replace(
+        role=jnp.asarray([R_LEADER, R_FOLLOWER, R_FOLLOWER, R_FOLLOWER], jnp.int32),
+        current_term=jnp.asarray([2, 0, 0, 0], jnp.int32),
+        last_index=jnp.asarray([3, 0, 0, 0], jnp.int32),
+        last_term=jnp.asarray([2, 0, 0, 0], jnp.int32),
+        written_index=jnp.asarray([3, 0, 0, 0], jnp.int32),
+        term_suffix=st.term_suffix.at[0, jnp.asarray([1, 2, 3]) % K].set(2),
+    )
+    mbox = empty_mailbox(4)
+    mbox = mbox._replace(
+        msg_type=jnp.asarray([MSG_AER_REPLY, MSG_NONE, MSG_NONE, MSG_NONE], jnp.int32),
+        sender_slot=jnp.asarray([1, 0, 0, 0], jnp.int32),
+        term=jnp.asarray([2, 0, 0, 0], jnp.int32),
+        success=jnp.asarray([True, False, False, False]),
+        reply_last_idx=jnp.asarray([3, 0, 0, 0], jnp.int32),
+        reply_next_idx=jnp.asarray([4, 0, 0, 0], jnp.int32),
+    )
+    new_st, eg = consensus_step(st, mbox)
+    assert int(new_st.match_index[0, 1]) == 3
+    assert int(new_st.next_index[0, 1]) == 4
+    # quorum of 2/3 (self written=3 + peer1 match=3) commits at term 2
+    assert int(new_st.commit_index[0]) == 3
+    assert int(eg.commit_advanced_to[0]) == 3
+
+
+def test_election_progression_prevote_candidate_leader():
+    st = make_group_state(1, 3, K)
+    st = st._replace(role=jnp.asarray([1], jnp.int32))  # pre_vote
+    mbox = empty_mailbox(1)._replace(
+        msg_type=jnp.asarray([6], jnp.int32),  # MSG_PREVOTE_REPLY
+        sender_slot=jnp.asarray([1], jnp.int32),
+        success=jnp.asarray([True]),
+    )
+    st2, eg = consensus_step(st, mbox)
+    assert bool(eg.became_candidate[0])
+    assert int(st2.role[0]) == 2  # candidate
+    assert int(st2.current_term[0]) == 1
+    assert int(st2.voted_for[0]) == 0  # self slot
+    mbox2 = empty_mailbox(1)._replace(
+        msg_type=jnp.asarray([4], jnp.int32),  # MSG_VOTE_REPLY
+        sender_slot=jnp.asarray([2], jnp.int32),
+        term=jnp.asarray([1], jnp.int32),
+        success=jnp.asarray([True]),
+    )
+    st3, eg2 = consensus_step(st2, mbox2)
+    assert bool(eg2.became_leader[0])
+    assert int(st3.role[0]) == R_LEADER
+    assert int(st3.leader_slot[0]) == 0
+
+
+def test_record_appended_and_written_helpers():
+    st = make_group_state(4, 3, K)
+    gids = jnp.asarray([0, 0, 2], jnp.int32)
+    idxs = jnp.asarray([1, 2, 1], jnp.int32)
+    terms = jnp.asarray([1, 1, 5], jnp.int32)
+    st = record_appended(st, gids, idxs, terms)
+    assert int(st.last_index[0]) == 2 and int(st.last_term[0]) == 1
+    assert int(st.last_index[2]) == 1 and int(st.last_term[2]) == 5
+    assert int(st.last_index[1]) == 0
+    t, known = term_at(st, jnp.asarray([2, 0, 1, 0], jnp.int32))
+    assert bool(known[0]) and int(t[0]) == 1
+    st = record_written(st, jnp.asarray([0], jnp.int32), jnp.asarray([2], jnp.int32))
+    assert int(st.written_index[0]) == 2
+
+
+def test_sharded_step_matches_single_device():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.default_rng(7)
+    st = random_state(rng, g=64)
+    mbox = empty_mailbox(64)._replace(
+        msg_type=jnp.asarray(rng.integers(0, 7, 64), jnp.int32),
+        sender_slot=jnp.asarray(rng.integers(0, PEERS, 64), jnp.int32),
+        term=jnp.asarray(rng.integers(0, 8, 64), jnp.int32),
+        prev_idx=jnp.asarray(rng.integers(0, 40, 64), jnp.int32),
+        prev_term=jnp.asarray(rng.integers(0, 6, 64), jnp.int32),
+        num_entries=jnp.asarray(rng.integers(0, 5, 64), jnp.int32),
+        leader_commit=jnp.asarray(rng.integers(0, 50, 64), jnp.int32),
+        success=jnp.asarray(rng.random(64) < 0.5),
+        reply_last_idx=jnp.asarray(rng.integers(0, 40, 64), jnp.int32),
+        reply_next_idx=jnp.asarray(rng.integers(1, 40, 64), jnp.int32),
+        cand_last_idx=jnp.asarray(rng.integers(0, 40, 64), jnp.int32),
+        cand_last_term=jnp.asarray(rng.integers(0, 6, 64), jnp.int32),
+        cand_machine_version=jnp.asarray(rng.integers(0, 4, 64), jnp.int32),
+    )
+    ref_st, ref_eg = consensus_step(
+        jax.tree.map(jnp.copy, st), jax.tree.map(jnp.copy, mbox)
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]), ("groups",))
+    shard = NamedSharding(mesh, P("groups"))
+    rep = NamedSharding(mesh, P())
+
+    def place(x):
+        if x.ndim >= 1 and x.shape[0] == 64:
+            return jax.device_put(x, shard)
+        return jax.device_put(x, rep)
+
+    st_sh = jax.tree.map(place, st)
+    mbox_sh = jax.tree.map(place, mbox)
+    sh_st, sh_eg = consensus_step(st_sh, mbox_sh)
+    for a, b in zip(jax.tree.leaves(ref_st), jax.tree.leaves(sh_st)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ref_eg), jax.tree.leaves(sh_eg)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
