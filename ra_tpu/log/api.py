@@ -41,6 +41,10 @@ class LogApi:
         """Truncate the log tail down to idx (divergence handling)."""
         raise NotImplementedError
 
+    def write_sparse(self, entry: Entry) -> None:
+        """Out-of-order write of a live entry during snapshot install."""
+        raise NotImplementedError
+
     # -- reads -------------------------------------------------------------
 
     def last_index_term(self) -> Tuple[int, int]:

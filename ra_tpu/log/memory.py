@@ -63,6 +63,15 @@ class MemoryLog(LogApi):
         else:
             self._pending = self._pending.add(e.index)
 
+    def write_sparse(self, entry: Entry) -> None:
+        self.entries[entry.index] = entry
+        if entry.index > self._last_index:
+            self._last_index = entry.index
+            self._last_term = entry.term
+            if self.auto_written:
+                self._written_index = entry.index
+                self._written_term = entry.term
+
     def set_last_index(self, idx: int) -> None:
         for i in range(idx + 1, self._last_index + 1):
             self.entries.pop(i, None)

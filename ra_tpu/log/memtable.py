@@ -1,0 +1,73 @@
+"""Per-server in-memory table of recent log entries.
+
+Plays the role of the reference's ETS-backed memtables (reference:
+``src/ra_mt.erl`` — strictly-monotone inserts, flush-driven deletion,
+range tracking), re-designed as a plain dict + range bookkeeping owned by
+the runtime's table registry (``ra_tpu.log.tables``). Entries live here
+from the moment they are appended until the segment writer has flushed
+them to disk; reads always prefer the memtable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ra_tpu.protocol import Entry
+from ra_tpu.utils.seq import Seq
+
+
+class MemTable:
+    __slots__ = ("uid", "entries", "_seq")
+
+    def __init__(self, uid: str):
+        self.uid = uid
+        self.entries: Dict[int, Entry] = {}
+        self._seq: Seq = Seq.empty()
+
+    def insert(self, entry: Entry) -> None:
+        """Insert; overwriting an existing index truncates everything at
+        and above it first (divergent-suffix rewrite)."""
+        if entry.index in self.entries:
+            self.truncate_from(entry.index)
+        self.entries[entry.index] = entry
+        self._seq = self._seq.add(entry.index)
+
+    def insert_sparse(self, entry: Entry) -> None:
+        """Out-of-order insert for snapshot live entries."""
+        self.entries[entry.index] = entry
+        self._seq = self._seq.add(entry.index)
+
+    def truncate_from(self, idx: int) -> None:
+        for i in list(self.entries):
+            if i >= idx:
+                del self.entries[i]
+        self._seq = self._seq.limit(idx - 1)
+
+    def get(self, idx: int) -> Optional[Entry]:
+        return self.entries.get(idx)
+
+    def record_flushed(self, seq: Seq) -> None:
+        """Delete entries the segment writer has persisted."""
+        for i in seq:
+            self.entries.pop(i, None)
+        self._seq = self._seq.subtract(seq)
+
+    def set_first(self, idx: int, live=None) -> None:
+        """Drop everything below idx (snapshot truncation), retaining any
+        indexes in `live` (a Seq of live indexes below the snapshot)."""
+        for i in list(self.entries):
+            if i < idx and (live is None or i not in live):
+                del self.entries[i]
+        kept = self._seq.floor(idx)
+        if live is not None:
+            kept = kept.union(self._seq.intersect(live))
+        self._seq = kept
+
+    def seq(self) -> Seq:
+        return self._seq
+
+    def range(self) -> Optional[Tuple[int, int]]:
+        return self._seq.range()
+
+    def __len__(self) -> int:
+        return len(self.entries)

@@ -1,0 +1,137 @@
+"""On-disk immutable-ish segment files.
+
+Long-term home of log entries once the WAL rolls over — the counterpart
+of the reference's segment files (reference: ``src/ra_log_segment.erl``
+— fixed index region + data region, per-entry CRC, sparse reads via
+binary search, bounded pending writes). Layout (little-endian):
+
+    header : magic b"RTS1" | max_count u32
+    index  : max_count slots of (idx u64 | term u64 | offset u64 |
+             length u32 | crc u32)  — slot order = append order
+    data   : concatenated payloads
+
+Index slots are written incrementally as entries append (buffered, then
+flushed+fsynced on ``sync``). An unfilled slot has idx 0 (indexes are
+>= 1), so recovery simply stops at the first empty slot.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+MAGIC = b"RTS1"
+_HDR = struct.Struct("<4sI")
+_SLOT = struct.Struct("<QQQII")
+
+
+class SegmentWriterHandle:
+    """Append handle for one segment file."""
+
+    def __init__(self, path: str, max_count: int = 4096, compute_checksums: bool = True):
+        self.path = path
+        self.max_count = max_count
+        self.compute_checksums = compute_checksums
+        self.count = 0
+        self.range: Optional[Tuple[int, int]] = None
+        exists = os.path.exists(path)
+        self._f = open(path, "r+b" if exists else "w+b")
+        if not exists or os.path.getsize(path) < _HDR.size:
+            self._f.write(_HDR.pack(MAGIC, max_count))
+            self._f.write(b"\x00" * (_SLOT.size * max_count))
+            self._f.flush()
+            self._data_end = self._data_start
+        else:
+            magic, mc = _HDR.unpack(self._f.read(_HDR.size))
+            if magic != MAGIC:
+                raise ValueError(f"bad segment magic in {path}")
+            self.max_count = mc
+            # scan index to find fill level
+            idx_bytes = self._f.read(_SLOT.size * mc)
+            end = self._data_start
+            for i in range(mc):
+                idx, term, off, ln, crc = _SLOT.unpack_from(idx_bytes, i * _SLOT.size)
+                if idx == 0:
+                    break
+                self.count += 1
+                self.range = (self.range[0], idx) if self.range else (idx, idx)
+                end = max(end, off + ln)
+            self._data_end = end
+
+    @property
+    def _data_start(self) -> int:
+        return _HDR.size + _SLOT.size * self.max_count
+
+    def is_full(self) -> bool:
+        return self.count >= self.max_count
+
+    def append(self, idx: int, term: int, payload: bytes) -> None:
+        if self.is_full():
+            raise ValueError("segment full")
+        crc = zlib.crc32(payload) if self.compute_checksums else 0
+        off = self._data_end
+        self._f.seek(off)
+        self._f.write(payload)
+        self._f.seek(_HDR.size + self.count * _SLOT.size)
+        self._f.write(_SLOT.pack(idx, term, off, len(payload), crc))
+        self._data_end = off + len(payload)
+        self.count += 1
+        self.range = (self.range[0], idx) if self.range else (idx, idx)
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fdatasync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+
+
+class SegmentReader:
+    """Read-only view over one segment file; index parsed once on open
+    (the reference's "map mode"; binary-search-on-disk mode is a later
+    optimization)."""
+
+    def __init__(self, path: str, compute_checksums: bool = True):
+        self.path = path
+        self.compute_checksums = compute_checksums
+        self._f = open(path, "rb")
+        magic, mc = _HDR.unpack(self._f.read(_HDR.size))
+        if magic != MAGIC:
+            raise ValueError(f"bad segment magic in {path}")
+        idx_bytes = self._f.read(_SLOT.size * mc)
+        # idx -> (term, offset, length, crc); later slots win (rewrites)
+        self.index: Dict[int, Tuple[int, int, int, int]] = {}
+        self.range: Optional[Tuple[int, int]] = None
+        for i in range(mc):
+            idx, term, off, ln, crc = _SLOT.unpack_from(idx_bytes, i * _SLOT.size)
+            if idx == 0:
+                break
+            self.index[idx] = (term, off, ln, crc)
+        if self.index:
+            self.range = (min(self.index), max(self.index))
+
+    def term(self, idx: int) -> Optional[int]:
+        e = self.index.get(idx)
+        return e[0] if e else None
+
+    def read(self, idx: int) -> Optional[Tuple[int, bytes]]:
+        e = self.index.get(idx)
+        if e is None:
+            return None
+        term, off, ln, crc = e
+        self._f.seek(off)
+        payload = self._f.read(ln)
+        if self.compute_checksums and crc and zlib.crc32(payload) != crc:
+            raise IOError(f"segment crc mismatch at idx {idx} in {self.path}")
+        return term, payload
+
+    def indexes(self) -> List[int]:
+        return sorted(self.index)
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,184 @@
+"""Segment writer: flushes rolled-over memtable ranges to per-server
+segment files.
+
+The reference's ``ra_log_segment_writer`` (``src/ra_log_segment_writer
+.erl``): one per system; takes ``{uid: seq}`` jobs from the WAL at
+rollover, truncates the flush floor by each server's snapshot state,
+appends entries from the memtable to the server's open segment (rolling
+to a new segment when full), fsyncs, then notifies the server with
+``("segments", flushed_seq, new_refs)`` so it can update its segment set
+and shrink its memtable. Deletes the WAL file once flushed.
+
+Runs jobs on a background thread (``threaded=False`` for deterministic
+tests).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ra_tpu import counters as ra_counters
+from ra_tpu.log.segment import SegmentWriterHandle
+from ra_tpu.log.tables import TableRegistry
+from ra_tpu.utils.seq import Seq
+
+NotifyFn = Callable[[str, object], None]
+
+
+class SegmentWriter:
+    def __init__(
+        self,
+        data_dir: str,
+        tables: TableRegistry,
+        notify: NotifyFn,
+        max_entries: int = 4096,
+        threaded: bool = True,
+        counter=None,
+    ):
+        self.data_dir = data_dir
+        self.tables = tables
+        self.notify = notify
+        self.max_entries = max_entries
+        self.counter = counter or ra_counters.Counters(
+            "segment_writer", ra_counters.SEGMENT_WRITER_FIELDS
+        )
+        self._open: Dict[str, SegmentWriterHandle] = {}
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._closed = False
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread: Optional[threading.Thread] = None
+        if threaded:
+            self._thread = threading.Thread(
+                target=self._run, name="ra-segment-writer", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+
+    def flush_mem_tables(self, seqs: Dict[str, Seq], wal_file: Optional[str] = None) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._queue.append((seqs, wal_file))
+            self._idle.clear()
+            self._cv.notify()
+        if self._thread is None:
+            self._drain()
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        return self._idle.wait(timeout)
+
+    def my_segments(self, uid: str) -> List[str]:
+        d = self._server_dir(uid)
+        if not os.path.isdir(d):
+            return []
+        return sorted(f for f in os.listdir(d) if f.endswith(".segment"))
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._drain()
+        for h in self._open.values():
+            h.close()
+        self._open.clear()
+
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._idle.set()
+                    self._cv.wait(timeout=0.5)
+                if self._closed and not self._queue:
+                    self._idle.set()
+                    return
+            self._drain()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                if not self._queue:
+                    self._idle.set()
+                    return
+                seqs, wal_file = self._queue.popleft()
+            try:
+                self._flush_job(seqs)
+            finally:
+                if wal_file and os.path.exists(wal_file):
+                    os.unlink(wal_file)
+
+    def _flush_job(self, seqs: Dict[str, Seq]) -> None:
+        for uid, seq in seqs.items():
+            # flush floor: skip dead indexes below the snapshot, keep live
+            # ones (reference: start_index/smallest_live_idx truncation,
+            # src/ra_log_segment_writer.erl:268-390)
+            snap_idx = self.tables.snapshot_index(uid)
+            live = self.tables.live_indexes(uid)
+            keep = seq.floor(snap_idx + 1).union(seq.intersect(live))
+            mt = self.tables.mem_table(uid)
+            new_refs: List[Tuple[str, Tuple[int, int]]] = []
+            handle = self._open_segment(uid)
+            wrote = 0
+            for idx in keep:
+                entry = mt.get(idx)
+                if entry is None:
+                    continue  # already truncated/compacted away
+                if handle.is_full():
+                    handle.sync()
+                    handle.close()
+                    if handle.range:
+                        new_refs.append((os.path.basename(handle.path), handle.range))
+                    handle = self._roll_segment(uid)
+                handle.append(entry.index, entry.term, pickle.dumps(entry.cmd))
+                wrote += 1
+            if wrote:
+                handle.sync()
+                self.counter.incr("entries_flushed", wrote)
+            self.counter.incr("mem_tables_flushed")
+            if handle.range:
+                new_refs.append((os.path.basename(handle.path), handle.range))
+            self.notify(uid, ("segments", seq, new_refs))
+
+    def _server_dir(self, uid: str) -> str:
+        return os.path.join(self.data_dir, uid, "segments")
+
+    def _open_segment(self, uid: str) -> SegmentWriterHandle:
+        h = self._open.get(uid)
+        if h is not None:
+            return h
+        d = self._server_dir(uid)
+        os.makedirs(d, exist_ok=True)
+        existing = self.my_segments(uid)
+        if existing:
+            h = SegmentWriterHandle(
+                os.path.join(d, existing[-1]), max_count=self.max_entries
+            )
+            if h.is_full():
+                h.close()
+                h = self._new_segment(uid, existing[-1])
+        else:
+            h = self._new_segment(uid, None)
+        self._open[uid] = h
+        return h
+
+    def _roll_segment(self, uid: str) -> SegmentWriterHandle:
+        prev = os.path.basename(self._open[uid].path)
+        h = self._new_segment(uid, prev)
+        self._open[uid] = h
+        return h
+
+    def _new_segment(self, uid: str, prev_name: Optional[str]) -> SegmentWriterHandle:
+        n = int(prev_name.split(".")[0]) + 1 if prev_name else 1
+        path = os.path.join(self._server_dir(uid), f"{n:08d}.segment")
+        self.counter.incr("segments_created")
+        return SegmentWriterHandle(path, max_count=self.max_entries)

@@ -1,0 +1,146 @@
+"""Per-server set of segment files + open-file cache + compaction.
+
+The role of the reference's ``ra_log_segments`` (segment-ref set, FLRU
+fd cache, compaction planning — ``src/ra_log_segments.erl``). Round-1
+compaction scope: snapshot-floor truncation deletes whole segments whose
+range is entirely dead, and minor compaction rewrites a segment that
+still holds live indexes; crash-safe via write-new + atomic rename.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+from ra_tpu.log.segment import SegmentReader, SegmentWriterHandle
+from ra_tpu.protocol import Entry
+from ra_tpu.utils.flru import FLRU
+from ra_tpu.utils.seq import Seq
+
+
+class SegmentSet:
+    def __init__(self, dir: str, open_cache: int = 8):
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+        # filename -> (lo, hi) inclusive range
+        self.refs: Dict[str, Tuple[int, int]] = {}
+        self._cache: FLRU[str, SegmentReader] = FLRU(
+            open_cache, on_evict=lambda k, r: r.close()
+        )
+        for f in sorted(os.listdir(dir)):
+            if f.endswith(".segment"):
+                try:
+                    r = SegmentReader(os.path.join(dir, f))
+                except (ValueError, OSError):
+                    continue
+                if r.range:
+                    self.refs[f] = r.range
+                r.close()
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def add_ref(self, fname: str, rng: Tuple[int, int]) -> None:
+        self.refs[fname] = rng
+        self._cache.evict(fname)  # re-open to see new entries
+
+    def num_segments(self) -> int:
+        return len(self.refs)
+
+    def _reader(self, fname: str) -> SegmentReader:
+        r = self._cache.get(fname)
+        if r is None:
+            r = SegmentReader(os.path.join(self.dir, fname))
+            self._cache.insert(fname, r)
+        return r
+
+    def files_for(self, idx: int) -> List[str]:
+        """Newest-first list of files whose range covers idx (later files
+        hold rewrites and win)."""
+        return [
+            f
+            for f in sorted(self.refs, reverse=True)
+            if self.refs[f][0] <= idx <= self.refs[f][1]
+        ]
+
+    # -- reads ------------------------------------------------------------
+
+    def fetch_term(self, idx: int) -> Optional[int]:
+        for f in self.files_for(idx):
+            t = self._reader(f).term(idx)
+            if t is not None:
+                return t
+        return None
+
+    def fetch(self, idx: int) -> Optional[Entry]:
+        for f in self.files_for(idx):
+            got = self._reader(f).read(idx)
+            if got is not None:
+                term, payload = got
+                return Entry(idx, term, pickle.loads(payload))
+        return None
+
+    def range(self) -> Optional[Tuple[int, int]]:
+        if not self.refs:
+            return None
+        return (
+            min(lo for lo, _ in self.refs.values()),
+            max(hi for _, hi in self.refs.values()),
+        )
+
+    # -- compaction -------------------------------------------------------
+
+    def truncate_below(self, snapshot_idx: int, live: Seq) -> int:
+        """Snapshot moved to snapshot_idx: delete segments that hold no
+        index above it and no live index; minor-compact segments that
+        straddle the floor but keep live/tail entries. Returns number of
+        files removed."""
+        removed = 0
+        for f in sorted(self.refs):
+            lo, hi = self.refs[f]
+            if lo > snapshot_idx:
+                continue
+            # live entries below the floor plus the tail above it survive
+            keep = live.in_range(lo, hi).union(
+                Seq.from_range(max(lo, snapshot_idx + 1), hi)
+            )
+            if keep.is_empty():
+                self._cache.evict(f)
+                try:
+                    os.unlink(os.path.join(self.dir, f))
+                except OSError:
+                    pass
+                del self.refs[f]
+                removed += 1
+            elif len(keep) < (hi - lo + 1):
+                self._minor_compact(f, keep)
+        return removed
+
+    def _minor_compact(self, fname: str, keep: Seq) -> None:
+        """Rewrite fname with only `keep` indexes. Crash-safe: write
+        `.compacting`, fsync, atomic-rename over the original (reference
+        uses the same write-new/rename shape: COMPACTION.md marker
+        protocol)."""
+        src = self._reader(fname)
+        tmp_path = os.path.join(self.dir, fname + ".compacting")
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+        w = SegmentWriterHandle(tmp_path, max_count=max(len(keep), 1))
+        lo = hi = None
+        for idx in keep:
+            got = src.read(idx)
+            if got is None:
+                continue
+            term, payload = got
+            w.append(idx, term, payload)
+            lo = idx if lo is None else lo
+            hi = idx
+        w.sync()
+        w.close()
+        self._cache.evict(fname)
+        os.replace(tmp_path, os.path.join(self.dir, fname))
+        if lo is not None:
+            self.refs[fname] = (lo, hi)
+
+    def close(self) -> None:
+        self._cache.evict_all()

@@ -1,0 +1,227 @@
+"""Snapshot subsystem: durable machine-state captures + chunked transfer.
+
+Capability parity with the reference's ``ra_snapshot`` (``src/
+ra_snapshot.erl``): a pluggable codec behaviour; three capture kinds —
+``snapshot`` (replicated, truncates the log), ``checkpoint`` (local
+only, promotable), ``recovery`` (orderly-shutdown state to skip replay);
+directory layout ``<server_dir>/{snapshots,checkpoints,recovery}/
+<Term>_<Index>/``; chunked read/accept protocol for remote installs;
+CRC-validated recovery that skips corrupt captures.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import struct
+import zlib
+from typing import Any, Iterator, List, Optional, Tuple
+
+from ra_tpu.protocol import SnapshotMeta
+from ra_tpu.utils.lib import sync_dir
+from ra_tpu.utils.seq import Seq
+
+SNAPSHOT = "snapshots"
+CHECKPOINT = "checkpoints"
+RECOVERY = "recovery"
+
+_TRAILER = struct.Struct("<I")
+
+
+class SnapshotCodec:
+    """Pluggable serialization behaviour (cf. the reference's snapshot
+    behaviour callbacks: prepare/write/begin_read/read_chunk/
+    begin_accept/accept_chunk/complete_accept/recover/validate)."""
+
+    name = "pickle"
+
+    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any) -> None:
+        raise NotImplementedError
+
+    def read(self, dir: str) -> Tuple[SnapshotMeta, Any]:
+        raise NotImplementedError
+
+    def read_meta(self, dir: str) -> SnapshotMeta:
+        raise NotImplementedError
+
+    def validate(self, dir: str) -> bool:
+        raise NotImplementedError
+
+
+class PickleCodec(SnapshotCodec):
+    """Default codec: CRC-trailered pickle files (``meta.dat`` +
+    ``snapshot.dat``)."""
+
+    @staticmethod
+    def _write_file(path: str, obj: Any) -> None:
+        payload = pickle.dumps(obj)
+        with open(path, "wb") as f:
+            f.write(payload)
+            f.write(_TRAILER.pack(zlib.crc32(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+
+    @staticmethod
+    def _read_file(path: str) -> Any:
+        data = open(path, "rb").read()
+        if len(data) < _TRAILER.size:
+            raise IOError(f"snapshot file too short: {path}")
+        payload, (crc,) = data[: -_TRAILER.size], _TRAILER.unpack(data[-_TRAILER.size :])
+        if crc and zlib.crc32(payload) != crc:
+            raise IOError(f"snapshot crc mismatch: {path}")
+        return pickle.loads(payload)
+
+    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any) -> None:
+        self._write_file(os.path.join(dir, "meta.dat"), meta)
+        self._write_file(os.path.join(dir, "snapshot.dat"), machine_state)
+
+    def read(self, dir: str) -> Tuple[SnapshotMeta, Any]:
+        return (
+            self._read_file(os.path.join(dir, "meta.dat")),
+            self._read_file(os.path.join(dir, "snapshot.dat")),
+        )
+
+    def read_meta(self, dir: str) -> SnapshotMeta:
+        return self._read_file(os.path.join(dir, "meta.dat"))
+
+    def validate(self, dir: str) -> bool:
+        try:
+            self.read(dir)
+            return True
+        except Exception:
+            return False
+
+
+class SnapshotStore:
+    """Per-server snapshot/checkpoint directory manager."""
+
+    def __init__(self, server_dir: str, codec: Optional[SnapshotCodec] = None,
+                 max_checkpoints: int = 10):
+        self.server_dir = server_dir
+        self.codec = codec or PickleCodec()
+        self.max_checkpoints = max_checkpoints
+        for kind in (SNAPSHOT, CHECKPOINT, RECOVERY):
+            os.makedirs(os.path.join(server_dir, kind), exist_ok=True)
+
+    # -- naming -------------------------------------------------------------
+
+    @staticmethod
+    def _dirname(meta: SnapshotMeta) -> str:
+        return f"{meta.term:016X}_{meta.index:016X}"
+
+    @staticmethod
+    def _parse(dirname: str) -> Optional[Tuple[int, int]]:
+        try:
+            t, i = dirname.split("_")
+            return int(t, 16), int(i, 16)
+        except ValueError:
+            return None
+
+    def _kind_dir(self, kind: str) -> str:
+        return os.path.join(self.server_dir, kind)
+
+    def _list(self, kind: str) -> List[Tuple[int, int, str]]:
+        """[(index, term, path)] ascending by index."""
+        out = []
+        d = self._kind_dir(kind)
+        for name in os.listdir(d):
+            p = self._parse(name)
+            if p is None:
+                continue
+            term, idx = p
+            out.append((idx, term, os.path.join(d, name)))
+        return sorted(out)
+
+    # -- writes -------------------------------------------------------------
+
+    def write(self, meta: SnapshotMeta, machine_state: Any, kind: str = SNAPSHOT) -> str:
+        """Durably write a capture; crash-safe via tmp dir + rename."""
+        d = self._kind_dir(kind)
+        final = os.path.join(d, self._dirname(meta))
+        tmp = final + ".writing"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        self.codec.write(tmp, meta, machine_state)
+        os.replace(tmp, final)
+        sync_dir(d)
+        if kind == SNAPSHOT:
+            # keep the previous generation as a corruption safety net
+            self._prune_count(SNAPSHOT, 2)
+            self._prune_older(CHECKPOINT, meta.index + 1)
+        elif kind == CHECKPOINT:
+            self._prune_count(CHECKPOINT, self.max_checkpoints)
+        return final
+
+    def _prune_older(self, kind: str, below_idx: int) -> None:
+        for idx, term, path in self._list(kind):
+            if idx < below_idx:
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _prune_count(self, kind: str, max_n: int) -> None:
+        entries = self._list(kind)
+        while len(entries) > max_n:
+            idx, term, path = entries.pop(0)
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- reads ---------------------------------------------------------------
+
+    def current(self, kind: str = SNAPSHOT) -> Optional[SnapshotMeta]:
+        for idx, term, path in reversed(self._list(kind)):
+            try:
+                return self.codec.read_meta(path)
+            except Exception:
+                continue
+        return None
+
+    def read(self, kind: str = SNAPSHOT) -> Optional[Tuple[SnapshotMeta, Any]]:
+        for idx, term, path in reversed(self._list(kind)):
+            try:
+                return self.codec.read(path)
+            except Exception:
+                continue  # corrupt capture: fall back to the previous one
+        return None
+
+    def latest_checkpoint_at_or_below(self, idx: int) -> Optional[Tuple[SnapshotMeta, Any]]:
+        for cidx, term, path in reversed(self._list(CHECKPOINT)):
+            if cidx > idx:
+                continue
+            try:
+                return self.codec.read(path)
+            except Exception:
+                continue
+        return None
+
+    def promote_checkpoint(self, idx: int) -> Optional[SnapshotMeta]:
+        got = self.latest_checkpoint_at_or_below(idx)
+        if got is None:
+            return None
+        meta, state = got
+        self.write(meta, state, kind=SNAPSHOT)
+        return meta
+
+    # -- chunked transfer ------------------------------------------------------
+
+    def begin_read(self, chunk_size: int) -> Iterator[bytes]:
+        got = self.read(SNAPSHOT)
+        if got is None:
+            return iter(())
+        meta, state = got
+        blob = pickle.dumps(state)
+
+        def chunks():
+            for off in range(0, max(len(blob), 1), chunk_size):
+                yield blob[off : off + chunk_size]
+
+        return chunks()
+
+    def accept_chunks(self, meta: SnapshotMeta, chunks: List[bytes]) -> Any:
+        state = pickle.loads(b"".join(chunks))
+        self.write(meta, state, kind=SNAPSHOT)
+        return state
+
+    def delete_all(self) -> None:
+        for kind in (SNAPSHOT, CHECKPOINT, RECOVERY):
+            shutil.rmtree(self._kind_dir(kind), ignore_errors=True)
+            os.makedirs(self._kind_dir(kind), exist_ok=True)

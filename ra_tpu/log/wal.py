@@ -1,0 +1,362 @@
+"""Shared write-ahead log.
+
+One WAL per system serves *all* raft groups on the node: every append
+from every group funnels into one append-only file and one fsync per
+batch — the amortization trick at the heart of the reference's design
+(reference: ``src/ra_log_wal.erl`` — gen_batch_server batching, writer-id
+dictionary compression :482-499, per-writer gap detection :551-586,
+rollover handing memtable seqs to the segment writer :641-688, chunked
+recovery :393-470).
+
+File format (little-endian):
+
+    header   : magic b"RTW1"
+    uid-def  : kind=1 | ref u16 | len u16 | uid utf-8
+    entry    : kind=2 | ref u16 | idx u64 | term u64 | crc u32 | len u32
+               | payload
+    trunc    : kind=3 | ref u16 | idx u64   (explicit truncate-from marker)
+
+CRC32 covers idx|term|payload. A short/corrupt tail record is treated as
+a clean EOF (torn final write), matching standard WAL recovery rules.
+
+Threading: producers call ``write``/``truncate_write`` from any thread; a
+single writer thread drains the queue in batches of up to
+``max_batch_size``, performs one write+fsync, then fires the per-writer
+``("written", term, seq)`` notifications. ``threaded=False`` gives tests
+a deterministic ``flush()``-driven mode.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ra_tpu import counters as ra_counters
+from ra_tpu.log.tables import TableRegistry
+from ra_tpu.utils.seq import Seq
+
+MAGIC = b"RTW1"
+K_UID = 1
+K_ENTRY = 2
+K_TRUNC = 3
+
+_ENTRY_HDR = struct.Struct("<BHQQII")
+_UID_HDR = struct.Struct("<BHH")
+_TRUNC_HDR = struct.Struct("<BHQ")
+
+NotifyFn = Callable[[str, Any], None]
+
+
+class Wal:
+    def __init__(
+        self,
+        dir: str,
+        tables: TableRegistry,
+        notify: NotifyFn,
+        segment_writer=None,
+        max_size_bytes: int = 256 * 1024 * 1024,
+        max_batch_size: int = 8192,
+        sync_method: str = "datasync",  # datasync | sync | none
+        compute_checksums: bool = True,
+        threaded: bool = True,
+        counter=None,
+    ):
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+        self.tables = tables
+        self.notify = notify
+        self.segment_writer = segment_writer
+        self.max_size_bytes = max_size_bytes
+        self.max_batch_size = max_batch_size
+        self.sync_method = sync_method
+        self.compute_checksums = compute_checksums
+        self.counter = counter or ra_counters.Counters("wal", ra_counters.WAL_FIELDS)
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._closed = False
+
+        # per-open-file state
+        self._file = None
+        self._file_num = 0
+        self._file_path: Optional[str] = None
+        self._bytes = 0
+        self._uid_refs: Dict[str, int] = {}
+        self._file_seqs: Dict[str, Seq] = {}  # what this file holds, per uid
+        # per-writer last contiguous idx (gap detection)
+        self._last_idx: Dict[str, int] = {}
+
+        self._recover()
+        self._open_next()
+
+        self._thread: Optional[threading.Thread] = None
+        if threaded:
+            self._thread = threading.Thread(target=self._run, name="ra-wal", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # public API
+
+    def write(
+        self, uid: str, idx: int, term: int, payload: bytes, sparse: bool = False
+    ) -> bool:
+        """Queue an append. ``sparse`` marks out-of-order live-entry
+        writes (snapshot install pre-phase) that bypass gap detection.
+        Returns False when the WAL is closed."""
+        with self._cv:
+            if self._closed:
+                return False
+            self._queue.append(("s" if sparse else "w", uid, idx, term, payload))
+            self._cv.notify()
+        return True
+
+    def truncate_write(self, uid: str, idx: int) -> bool:
+        """Record an explicit truncate-from marker (divergent suffix
+        rewrite starts at idx)."""
+        with self._cv:
+            if self._closed:
+                return False
+            self._queue.append(("t", uid, idx, 0, b""))
+            self._cv.notify()
+        return True
+
+    def last_writer_seq(self, uid: str) -> Optional[int]:
+        with self._lock:
+            return self._last_idx.get(uid)
+
+    def flush(self) -> None:
+        """Drain and persist everything queued (synchronous mode / tests;
+        also used for orderly shutdown)."""
+        while True:
+            with self._lock:
+                batch = self._take_batch_locked()
+            if not batch:
+                return
+            self._write_batch(batch)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.flush()
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    # ------------------------------------------------------------------
+    # writer loop
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(timeout=0.5)
+                if self._closed and not self._queue:
+                    return
+                batch = self._take_batch_locked()
+            if batch:
+                self._write_batch(batch)
+
+    def _take_batch_locked(self) -> List[Tuple]:
+        batch = []
+        while self._queue and len(batch) < self.max_batch_size:
+            batch.append(self._queue.popleft())
+        return batch
+
+    def _write_batch(self, batch: List[Tuple]) -> None:
+        buf = bytearray()
+        # (uid, term) -> indexes written in this batch
+        written: Dict[Tuple[str, int], List[int]] = {}
+        resends: List[Tuple[str, int]] = []
+        for kind, uid, idx, term, payload in batch:
+            if kind == "t":
+                ref = self._uid_ref(uid, buf)
+                buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
+                self._last_idx[uid] = idx - 1
+                self._file_seqs[uid] = self._file_seqs.get(uid, Seq.empty()).limit(idx - 1)
+                continue
+            snap_idx = self.tables.snapshot_index(uid)
+            # drop writes below the snapshot floor (dead indexes); they
+            # still count as durable for the writer's bookkeeping
+            if idx <= snap_idx and idx not in self.tables.live_indexes(uid):
+                written.setdefault((uid, term), []).append(idx)
+                self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+                continue
+            if kind != "s":
+                last = self._last_idx.get(uid)
+                # indexes at or below the snapshot are durable-or-dead, so
+                # a jump to snap_idx+1 after a snapshot install is in-seq
+                if last is not None and idx > max(last, snap_idx) + 1:
+                    # gap: a write got lost upstream — ask the server to
+                    # resend from the hole instead of persisting out of
+                    # order
+                    self.counter.incr("out_of_seq")
+                    resends.append((uid, max(last, snap_idx) + 1))
+                    continue
+            ref = self._uid_ref(uid, buf)
+            crc = (
+                zlib.crc32(struct.pack("<QQ", idx, term) + payload)
+                if self.compute_checksums
+                else 0
+            )
+            buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc, len(payload))
+            buf += payload
+            if kind == "s":
+                self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+            else:
+                self._last_idx[uid] = idx
+            seq = self._file_seqs.get(uid, Seq.empty())
+            if idx <= (seq.last() or 0):
+                seq = seq.limit(idx - 1)  # overwrite rewinds
+            self._file_seqs[uid] = seq.add(idx)
+            written.setdefault((uid, term), []).append(idx)
+
+        if buf:
+            self._file.write(buf)
+            self._sync()
+            self.counter.incr("batches")
+            self.counter.incr("writes", len(batch))
+            self.counter.incr("bytes_written", len(buf))
+            self.counter.put("batch_size", len(batch))
+            self._bytes += len(buf)
+        for (uid, term), idxs in written.items():
+            self.notify(uid, ("written", term, Seq.from_list(idxs)))
+        for uid, from_idx in resends:
+            self.notify(uid, ("resend_write", from_idx))
+        if self._bytes >= self.max_size_bytes:
+            self._rollover()
+
+    def _sync(self) -> None:
+        self._file.flush()
+        if self.sync_method == "datasync":
+            os.fdatasync(self._file.fileno())
+            self.counter.incr("fsyncs")
+        elif self.sync_method == "sync":
+            os.fsync(self._file.fileno())
+            self.counter.incr("fsyncs")
+
+    def _uid_ref(self, uid: str, buf: bytearray) -> int:
+        ref = self._uid_refs.get(uid)
+        if ref is None:
+            ref = len(self._uid_refs) + 1
+            self._uid_refs[uid] = ref
+            ub = uid.encode()
+            buf += _UID_HDR.pack(K_UID, ref, len(ub))
+            buf += ub
+        return ref
+
+    # ------------------------------------------------------------------
+    # rollover & recovery
+
+    def _open_next(self) -> None:
+        self._file_num += 1
+        self._file_path = os.path.join(self.dir, f"{self._file_num:08d}.wal")
+        self._file = open(self._file_path, "ab")
+        if self._file.tell() == 0:
+            self._file.write(MAGIC)
+            self._file.flush()
+        self._bytes = self._file.tell()
+        self._uid_refs = {}
+        self._file_seqs = {}
+        self.counter.incr("wal_files")
+
+    def _rollover(self) -> None:
+        self.counter.incr("rollovers")
+        self._file.close()
+        full_path, seqs = self._file_path, self._file_seqs
+        self._open_next()
+        if self.segment_writer is not None:
+            self.segment_writer.flush_mem_tables(
+                {uid: seq for uid, seq in seqs.items() if not seq.is_empty()},
+                wal_file=full_path,
+            )
+        else:
+            os.unlink(full_path)
+
+    def force_rollover(self) -> None:
+        """Test/ops hook: roll the current file regardless of size."""
+        with self._lock:
+            self._rollover()
+
+    def _recover(self) -> None:
+        """Re-read surviving WAL files into memtables and hand them to the
+        segment writer, then start from a fresh file."""
+        files = sorted(
+            f for f in os.listdir(self.dir) if f.endswith(".wal")
+        )
+        from ra_tpu.protocol import Entry
+        import pickle
+
+        for fname in files:
+            path = os.path.join(self.dir, fname)
+            seqs: Dict[str, Seq] = {}
+            uids: Dict[int, str] = {}
+            try:
+                data = open(path, "rb").read()
+            except OSError:
+                continue
+            if not data.startswith(MAGIC):
+                os.unlink(path)
+                continue
+            pos = 4
+            n = len(data)
+            while pos < n:
+                kind = data[pos]
+                try:
+                    if kind == K_UID:
+                        _, ref, ln = _UID_HDR.unpack_from(data, pos)
+                        pos += _UID_HDR.size
+                        uids[ref] = data[pos : pos + ln].decode()
+                        pos += ln
+                    elif kind == K_TRUNC:
+                        _, ref, idx = _TRUNC_HDR.unpack_from(data, pos)
+                        pos += _TRUNC_HDR.size
+                        uid = uids[ref]
+                        self.tables.mem_table(uid).truncate_from(idx)
+                        seqs[uid] = seqs.get(uid, Seq.empty()).limit(idx - 1)
+                        self._last_idx[uid] = idx - 1
+                    elif kind == K_ENTRY:
+                        _, ref, idx, term, crc, ln = _ENTRY_HDR.unpack_from(data, pos)
+                        pos += _ENTRY_HDR.size
+                        payload = data[pos : pos + ln]
+                        if len(payload) < ln:
+                            break  # torn tail
+                        pos += ln
+                        if self.compute_checksums and crc:
+                            if zlib.crc32(struct.pack("<QQ", idx, term) + payload) != crc:
+                                break  # corrupt tail
+                        uid = uids[ref]
+                        mt = self.tables.mem_table(uid)
+                        mt.insert(Entry(idx, term, pickle.loads(payload)))
+                        seq = seqs.get(uid, Seq.empty())
+                        if idx <= (seq.last() or 0):
+                            seq = seq.limit(idx - 1)
+                        seqs[uid] = seq.add(idx)
+                        self._last_idx[uid] = idx
+                    else:
+                        break  # unknown/corrupt: stop at tail
+                except (struct.error, KeyError, IndexError, EOFError):
+                    break
+            live = {u: s for u, s in seqs.items() if not s.is_empty()}
+            if self.segment_writer is not None and live:
+                self.segment_writer.flush_mem_tables(live, wal_file=path)
+            else:
+                os.unlink(path)
+            num = int(fname.split(".")[0])
+            self._file_num = max(self._file_num, num)
+
+    def overview(self) -> Dict[str, Any]:
+        return {
+            "file": self._file_path,
+            "bytes": self._bytes,
+            "writers": len(self._last_idx),
+            "counters": self.counter.to_dict(),
+        }

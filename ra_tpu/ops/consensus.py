@@ -84,6 +84,11 @@ class GroupState(NamedTuple):
     votes: jax.Array  # bool[G, P]
     pre_votes: jax.Array  # bool[G, P]
     term_suffix: jax.Array  # i32[G, K] ring buffer of entry terms
+    # inclusive interval of indexes whose ring slots are stale (multi-
+    # entry accepts record only the tail term until the host reconciles
+    # via record_appended); empty when lo > hi
+    unknown_lo: jax.Array  # i32[G]
+    unknown_hi: jax.Array  # i32[G]
 
 
 class Mailbox(NamedTuple):
@@ -152,6 +157,8 @@ def make_group_state(num_groups: int, num_peers: int, suffix_k: int = 32) -> Gro
         votes=zb(g, p),
         pre_votes=zb(g, p),
         term_suffix=zi(g, k),
+        unknown_lo=jnp.ones((g,), jnp.int32),
+        unknown_hi=zi(g),
     )
 
 
@@ -193,8 +200,9 @@ def term_at(state: GroupState, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
     ).squeeze(-1)
     is_snap = idx == state.snapshot_index
     is_zero = idx <= 0
+    stale = (idx >= state.unknown_lo) & (idx <= state.unknown_hi)
     term = jnp.where(is_zero, 0, jnp.where(is_snap, state.snapshot_term, ring))
-    known = is_zero | is_snap | in_window
+    known = is_zero | is_snap | (in_window & ~stale)
     return term.astype(jnp.int32), known
 
 
@@ -281,6 +289,19 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
         (jnp.arange(kk)[None, :] == tail_slot) & takes_entries[:, None],
         mbox.entries_last_term[:, None],
         state.term_suffix,
+    )
+    # only the batch tail's term is exact: mark intermediate indexes of a
+    # multi-entry accept stale until the host record_appended reconciles
+    multi = takes_entries & (mbox.num_entries > 1)
+    had_inv = state.unknown_lo <= state.unknown_hi
+    unknown_lo2 = jnp.where(
+        multi,
+        jnp.where(had_inv, jnp.minimum(state.unknown_lo, mbox.prev_idx + 1),
+                  mbox.prev_idx + 1),
+        state.unknown_lo,
+    )
+    unknown_hi2 = jnp.where(
+        multi, jnp.maximum(state.unknown_hi, new_last - 1), state.unknown_hi
     )
     # followers' commit index: min(leader_commit, last entry index)
     commit2 = jnp.where(
@@ -378,7 +399,11 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
     agreed = jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
     agreed_term, agreed_known = term_at(
         state._replace(
-            last_index=last_index2, last_term=last_term2, term_suffix=term_suffix2
+            last_index=last_index2,
+            last_term=last_term2,
+            term_suffix=term_suffix2,
+            unknown_lo=unknown_lo2,
+            unknown_hi=unknown_hi2,
         ),
         agreed,
     )
@@ -434,6 +459,8 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
         votes=votes3,
         pre_votes=pre_votes3,
         term_suffix=term_suffix2,
+        unknown_lo=unknown_lo2,
+        unknown_hi=unknown_hi2,
     )
     return new_state, egress
 
@@ -464,7 +491,16 @@ def record_appended(
     touched = jnp.zeros_like(state.last_index, dtype=jnp.bool_).at[group_ids].set(True)
     ring_at_tail = jnp.take_along_axis(ts, (last_index % k)[:, None], axis=-1).squeeze(-1)
     last_term = jnp.where(touched, ring_at_tail, state.last_term)
-    return state._replace(term_suffix=ts, last_index=last_index, last_term=last_term)
+    # the host has reconciled these groups' rings exactly: clear staleness
+    unknown_lo = jnp.where(touched, 1, state.unknown_lo)
+    unknown_hi = jnp.where(touched, 0, state.unknown_hi)
+    return state._replace(
+        term_suffix=ts,
+        last_index=last_index,
+        last_term=last_term,
+        unknown_lo=unknown_lo,
+        unknown_hi=unknown_hi,
+    )
 
 
 @jax.jit

@@ -568,13 +568,12 @@ class Server:
         if not match:
             return
         agreed = dec.agreed_commit(match)
-        term_at = self.log.fetch_term(agreed)
-        new_ci = dec.new_commit_index(
-            match, self.commit_index, -1 if term_at is None else term_at, self.current_term
-        )
-        if new_ci > self.commit_index:
-            self.commit_index = new_ci
-            self._apply_to(new_ci, effects=effects)
+        if agreed > self.commit_index:
+            # current-term gate (Raft 5.4.2): same math as
+            # dec.new_commit_index, with the sort done once
+            if self.log.fetch_term(agreed) == self.current_term:
+                self.commit_index = agreed
+                self._apply_to(agreed, effects=effects)
 
     def _evaluate_queries(self, effects: EffectList) -> None:
         if not self.pending_queries:
@@ -1220,6 +1219,19 @@ class Server:
             return effects
         if isinstance(msg, PreVoteRpc):
             return self._process_pre_vote(msg, from_peer, effects)
+        if isinstance(msg, InstallSnapshotRpc):
+            if msg.term >= self.current_term:
+                # a leader exists and we are behind its snapshot: step
+                # down and take the transfer as a follower
+                self._update_term(msg.term)
+                self._become_follower(effects, leader=msg.leader_id)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
+            else:
+                li, lt = self.log.last_index_term()
+                effects.append(
+                    SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
+                )
+            return effects
         if isinstance(msg, ElectionTimeout):
             return self._call_for_election(effects)
         if isinstance(msg, LogEvent):
@@ -1260,11 +1272,13 @@ class Server:
                 )
                 return effects
             if msg.chunk_phase == CHUNK_PRE:
-                # sparse live entries preceding the snapshot body
+                # sparse live entries preceding the snapshot body; writes
+                # are idempotent so pre chunks just advance the cursor
+                acc["next_chunk"] = max(acc["next_chunk"], msg.chunk_no + 1)
                 entries = msg.data
                 for e in entries:
                     if self.log.fetch_term(e.index) is None:
-                        self._write_sparse(e)
+                        self.log.write_sparse(e)
                 effects.append(
                     SendRpc(
                         from_peer,
@@ -1272,7 +1286,21 @@ class Server:
                     )
                 )
                 return effects
-            # next / last
+            # next / last: validate chunk ordering — duplicates (sender
+            # retry after a lost ack) are re-acked without appending;
+            # future chunks are ignored so the sender retries in order
+            if msg.chunk_no < acc["next_chunk"]:
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        InstallSnapshotResult(
+                            self.current_term, msg.meta.index, msg.meta.term
+                        ),
+                    )
+                )
+                return effects
+            if msg.chunk_no > acc["next_chunk"]:
+                return effects
             acc["chunks"].append(msg.data)
             acc["next_chunk"] += 1
             if msg.chunk_phase == CHUNK_LAST:
@@ -1302,15 +1330,6 @@ class Server:
                 effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
             return effects
         return effects
-
-    def _write_sparse(self, entry: Entry) -> None:
-        # live entries may be non-contiguous; MemoryLog tolerates direct
-        # injection, the real log has a dedicated sparse write path
-        writer = getattr(self.log, "write_sparse", None)
-        if writer is not None:
-            writer(entry)
-        else:
-            self.log.entries[entry.index] = entry  # type: ignore[attr-defined]
 
     def _complete_snapshot(
         self, msg: InstallSnapshotRpc, from_peer: Optional[ServerId], effects: EffectList

@@ -14,7 +14,7 @@ import string
 import tempfile
 import threading
 import time
-from typing import Callable, Iterable, List, Sequence, Tuple, TypeVar
+from typing import Any, Callable, Iterable, List, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
