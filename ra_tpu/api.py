@@ -1,0 +1,385 @@
+"""Public client/ops API.
+
+The framework's counterpart of the reference's ``ra`` module
+(reference: ``src/ra.erl`` — start_cluster/start_server/restart/delete,
+process_command/pipeline_command, local/leader/consistent queries,
+membership management, leadership transfer, overview/metrics). Operates
+on in-proc nodes registered in ``ra_tpu.runtime.transport.registry()``;
+server ids are ``(name, node_name)`` tuples.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ra_tpu import leaderboard
+from ra_tpu.machine import Machine
+from ra_tpu.protocol import Command, ElectionTimeout, RA_JOIN, RA_LEAVE, ServerId, USR
+from ra_tpu.runtime.node import RaNode
+from ra_tpu.runtime.transport import registry as node_registry
+from ra_tpu.system import SystemConfig
+from ra_tpu.utils.lib import partition_parallel
+
+
+class Future:
+    __slots__ = ("_evt", "value")
+
+    def __init__(self) -> None:
+        self._evt = threading.Event()
+        self.value: Any = None
+
+    def set_result(self, v: Any) -> None:
+        self.value = v
+        self._evt.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._evt.wait(timeout):
+            raise TimeoutError("ra_tpu call timed out")
+        return self.value
+
+    def done(self) -> bool:
+        return self._evt.is_set()
+
+
+class RaError(Exception):
+    pass
+
+
+def _node(node_name: str) -> RaNode:
+    node = node_registry().get(node_name)
+    if node is None:
+        raise RaError(f"node {node_name!r} not running")
+    return node
+
+
+# ---------------------------------------------------------------------------
+# system / cluster lifecycle
+
+
+def start_node(name: str, config: Optional[SystemConfig] = None, **kw) -> RaNode:
+    return RaNode(name, config=config, **kw)
+
+
+def stop_node(name: str) -> None:
+    node = node_registry().get(name)
+    if node is not None:
+        node.stop()
+
+
+def start_server(
+    server_id: ServerId,
+    cluster_name: str,
+    machine: Machine,
+    members: Sequence[ServerId],
+    machine_config: Optional[dict] = None,
+) -> ServerId:
+    name, node_name = server_id
+    return _node(node_name).start_server(
+        name, cluster_name, machine, tuple(members), machine_config=machine_config
+    )
+
+
+def start_cluster(
+    cluster_name: str,
+    machine_factory: Callable[[], Machine],
+    server_ids: Sequence[ServerId],
+    timeout: float = 5.0,
+) -> Tuple[List[ServerId], List[ServerId]]:
+    """Start all members (in parallel, like the reference's
+    partition_parallel cluster start), elect a leader, return
+    (started, failed)."""
+    ids = list(server_ids)
+    oks, errs = partition_parallel(
+        lambda sid: start_server(sid, cluster_name, machine_factory(), ids),
+        ids,
+        timeout_s=timeout,
+    )
+    started = [sid for sid, _ in oks]
+    if started:
+        trigger_election(started[0])
+        wait_for_leader(cluster_name, timeout=timeout)
+    return started, [sid for sid, _ in errs]
+
+
+def delete_cluster(server_ids: Sequence[ServerId]) -> None:
+    for name, node_name in server_ids:
+        node = node_registry().get(node_name)
+        if node is not None:
+            node.delete_server(name)
+
+
+def restart_server(server_id: ServerId) -> ServerId:
+    name, node_name = server_id
+    return _node(node_name).restart_server(name)
+
+
+def stop_server(server_id: ServerId) -> None:
+    name, node_name = server_id
+    _node(node_name).stop_server(name)
+
+
+def trigger_election(server_id: ServerId) -> None:
+    name, node_name = server_id
+    node = _node(node_name)
+    proc = node.procs.get(name)
+    if proc is None:
+        raise RaError(f"server {server_id} not running")
+    proc.enqueue(ElectionTimeout())
+
+
+def wait_for_leader(cluster_name: str, timeout: float = 5.0) -> ServerId:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leader = leaderboard.lookup_leader(cluster_name)
+        if leader is not None and _is_running(leader):
+            return leader
+        time.sleep(0.01)
+    raise RaError(f"no leader for {cluster_name!r} within {timeout}s")
+
+
+def _is_running(sid: ServerId) -> bool:
+    node = node_registry().get(sid[1])
+    return node is not None and sid[0] in node.procs
+
+
+# ---------------------------------------------------------------------------
+# commands
+
+
+def process_command(
+    server_id: ServerId,
+    data: Any,
+    timeout: float = 5.0,
+    retry_on_timeout: bool = False,
+) -> Tuple[Any, ServerId]:
+    """Synchronous command: replicated, applied, machine reply returned.
+    Follows redirects to the current leader (reference: leader_call
+    redirect loop src/ra_server_proc.erl:278-299).
+
+    A timeout after the command reached a (possibly stale) leader is
+    surfaced as RaError by default — the command MAY still commit later.
+    ``retry_on_timeout=True`` rotates to other members instead, giving
+    at-least-once semantics (duplicates possible; dedup via machine-level
+    correlations, as in the reference)."""
+    deadline = time.monotonic() + timeout
+    target = server_id
+    tried: set = set()
+    while time.monotonic() < deadline:
+        fut = Future()
+        cmd = Command(kind=USR, data=data, reply_mode="await_consensus", from_ref=fut)
+        if not _try_send(target, cmd):
+            target = _next_target(server_id, target, tried)
+            continue
+        try:
+            # bounded per-attempt wait: a stale/partitioned leader may
+            # never answer
+            attempt = min(1.0, max(0.05, deadline - time.monotonic()))
+            reply = fut.result(timeout=attempt)
+        except TimeoutError:
+            if not retry_on_timeout:
+                raise RaError(
+                    f"command timed out against {target} (it may still commit)"
+                )
+            tried.add(target)
+            target = _next_target(server_id, target, tried)
+            continue
+        if reply[0] == "ok":
+            return reply[1], reply[2]
+        if reply[0] == "redirect":
+            leader = reply[1]
+            tried.add(target)
+            target = leader if leader is not None and leader != target else _next_target(
+                server_id, target, tried
+            )
+            continue
+        raise RaError(f"command failed: {reply!r}")
+    raise RaError("command timed out")
+
+
+def _try_send(sid: ServerId, msg: Any) -> bool:
+    node = node_registry().get(sid[1])
+    if node is None:
+        return False
+    return node.deliver(sid, msg, None)
+
+
+def _next_target(origin: ServerId, current: ServerId, tried: set) -> ServerId:
+    cluster = leaderboard.lookup_members(_cluster_of(origin) or "")
+    for sid in cluster:
+        if sid not in tried and sid != current and _is_running(sid):
+            return sid
+    time.sleep(0.02)
+    return origin
+
+
+def _cluster_of(sid: ServerId) -> Optional[str]:
+    node = node_registry().get(sid[1])
+    if node is None:
+        return None
+    uid = node.directory.uid_of(sid[0])
+    return node.directory.cluster_of(uid) if uid else None
+
+
+def pipeline_command(
+    server_id: ServerId, data: Any, correlation: Any, who: Any
+) -> bool:
+    """Async command: the applied notification arrives on the client sink
+    registered as ``who`` (reference: ra:pipeline_command + {applied,
+    Corrs} ra_events)."""
+    cmd = Command(kind=USR, data=data, reply_mode=("notify", correlation, who))
+    return _try_send(server_id, cmd)
+
+
+def register_client(node_name: str, who: Any, cb: Callable[[ServerId, list], None]) -> None:
+    _node(node_name).register_client_sink(who, cb)
+
+
+# ---------------------------------------------------------------------------
+# queries
+
+
+def local_query(server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0):
+    """Query any member's machine state directly (possibly stale)."""
+    fut = Future()
+    if not _try_send(server_id, ("local_query", fn, fut)):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)
+
+
+def leader_query(server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0):
+    """Query the leader's (uncommitted-read) machine state."""
+    cluster = _cluster_of(server_id)
+    leader = leaderboard.lookup_leader(cluster or "") or server_id
+    fut = Future()
+    if not _try_send(leader, ("leader_query", fn, fut)):
+        raise RaError(f"leader {leader} unreachable")
+    out = fut.result(timeout)
+    if out[0] == "redirect":
+        if out[1] is None:
+            raise RaError("no leader")
+        return leader_query(out[1], fn, timeout)
+    return out
+
+
+def consistent_query(
+    server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0
+):
+    """Linearizable read: the leader confirms leadership with a quorum
+    heartbeat round before answering (reference: heartbeat query_index
+    protocol)."""
+    deadline = time.monotonic() + timeout
+    cluster = _cluster_of(server_id)
+    target = leaderboard.lookup_leader(cluster or "") or server_id
+    while time.monotonic() < deadline:
+        fut = Future()
+        if not _try_send(target, ("consistent_query", fn, fut)):
+            time.sleep(0.02)
+            continue
+        out = fut.result(max(0.05, deadline - time.monotonic()))
+        if out[0] == "redirect":
+            target = out[1] or target
+            continue
+        return out
+    raise RaError("consistent_query timed out")
+
+
+def members(server_id: ServerId, timeout: float = 5.0) -> Tuple[List[ServerId], ServerId]:
+    fut = Future()
+    if not _try_send(server_id, ("state_query", lambda s: list(s.members()), fut)):
+        raise RaError(f"server {server_id} unreachable")
+    out = fut.result(timeout)
+    return out[1], out[2]
+
+
+def member_overview(server_id: ServerId, timeout: float = 5.0) -> dict:
+    fut = Future()
+    if not _try_send(server_id, ("state_query", lambda s: s.overview(), fut)):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)[1]
+
+
+def key_metrics(server_id: ServerId, timeout: float = 5.0) -> dict:
+    def km(s):
+        li, lt = s.log.last_index_term()
+        return {
+            "state": s.role,
+            "leader": s.leader_id,
+            "term": s.current_term,
+            "commit_index": s.commit_index,
+            "last_applied": s.last_applied,
+            "last_index": li,
+            "machine_version": s.effective_machine_version,
+        }
+
+    fut = Future()
+    if not _try_send(server_id, ("state_query", km, fut)):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)[1]
+
+
+# ---------------------------------------------------------------------------
+# membership / leadership
+
+
+def _leader_control(server_id: ServerId, msg_builder, timeout: float = 5.0):
+    deadline = time.monotonic() + timeout
+    cluster = _cluster_of(server_id)
+    target = leaderboard.lookup_leader(cluster or "") or server_id
+    tried: set = set()
+    while time.monotonic() < deadline:
+        fut = Future()
+        if not _try_send(target, msg_builder(fut)):
+            tried.add(target)
+            target = _next_target(server_id, target, tried)
+            continue
+        try:
+            out = fut.result(max(0.05, deadline - time.monotonic()))
+        except TimeoutError:
+            break
+        if isinstance(out, tuple) and out and out[0] == "redirect":
+            tried.add(target)
+            target = out[1] or _next_target(server_id, target, tried)
+            continue
+        return out
+    raise RaError("leader control call timed out")
+
+
+def add_member(server_id: ServerId, new_member: ServerId, voter: bool = True,
+               timeout: float = 5.0):
+    return _leader_control(
+        server_id,
+        lambda fut: Command(kind=RA_JOIN, data=(new_member, voter),
+                            reply_mode="await_consensus", from_ref=fut),
+        timeout,
+    )
+
+
+def remove_member(server_id: ServerId, member: ServerId, timeout: float = 5.0):
+    return _leader_control(
+        server_id,
+        lambda fut: Command(kind=RA_LEAVE, data=member,
+                            reply_mode="await_consensus", from_ref=fut),
+        timeout,
+    )
+
+
+def transfer_leadership(server_id: ServerId, target: ServerId, timeout: float = 5.0):
+    return _leader_control(
+        server_id, lambda fut: ("transfer_leadership", target, fut), timeout
+    )
+
+
+def aux_command(server_id: ServerId, cmd: Any, timeout: float = 5.0):
+    fut = Future()
+    if not _try_send(server_id, ("aux", "call", cmd, fut)):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)
+
+
+# ---------------------------------------------------------------------------
+
+
+def overview(node_name: str) -> dict:
+    return _node(node_name).overview()

@@ -1,0 +1,40 @@
+"""Leaderboard: zero-RPC leader discovery cache.
+
+Process-global ``cluster_name -> (leader, members)`` map updated on every
+leader change (the reference's public ``ra_leaderboard`` ETS,
+``src/ra_leaderboard.erl``), so clients pick the right member without a
+redirect round-trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ra_tpu.protocol import ServerId
+
+_lock = threading.Lock()
+_tab: Dict[str, Tuple[Optional[ServerId], Tuple[ServerId, ...]]] = {}
+
+
+def record(cluster_name: str, leader: Optional[ServerId], members) -> None:
+    with _lock:
+        _tab[cluster_name] = (leader, tuple(members))
+
+
+def lookup_leader(cluster_name: str) -> Optional[ServerId]:
+    got = _tab.get(cluster_name)
+    return got[0] if got else None
+
+
+def lookup_members(cluster_name: str) -> Tuple[ServerId, ...]:
+    got = _tab.get(cluster_name)
+    return got[1] if got else ()
+
+
+def clear(cluster_name: Optional[str] = None) -> None:
+    with _lock:
+        if cluster_name is None:
+            _tab.clear()
+        else:
+            _tab.pop(cluster_name, None)

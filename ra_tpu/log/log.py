@@ -24,7 +24,7 @@ from ra_tpu.log.segments import SegmentSet
 from ra_tpu.log.snapshot import CHECKPOINT, RECOVERY, SNAPSHOT, SnapshotStore
 from ra_tpu.log.tables import TableRegistry
 from ra_tpu.log.wal import Wal
-from ra_tpu.protocol import Entry, SnapshotMeta
+from ra_tpu.protocol import Entry, SnapshotMeta, encode_cmd
 from ra_tpu.utils.seq import Seq
 
 MIN_SNAPSHOT_INTERVAL = 4096
@@ -86,7 +86,7 @@ class Log(LogApi):
                 f"non-contiguous append {entry.index} after {self._last_index}"
             )
         self.mt.insert(entry)
-        self.wal.write(self.uid, entry.index, entry.term, pickle.dumps(entry.cmd))
+        self.wal.write(self.uid, entry.index, entry.term, encode_cmd(entry.cmd))
         self._last_index = entry.index
         self._last_term = entry.term
 
@@ -103,7 +103,7 @@ class Log(LogApi):
             self._rewind_to(first - 1)
         for e in entries:
             self.mt.insert(e)
-            self.wal.write(self.uid, e.index, e.term, pickle.dumps(e.cmd))
+            self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
         self._last_index = entries[-1].index
         self._last_term = entries[-1].term
 
@@ -111,7 +111,7 @@ class Log(LogApi):
         """Out-of-order live-entry write during snapshot install."""
         self.mt.insert_sparse(entry)
         self.wal.write(
-            self.uid, entry.index, entry.term, pickle.dumps(entry.cmd), sparse=True
+            self.uid, entry.index, entry.term, encode_cmd(entry.cmd), sparse=True
         )
 
     def set_last_index(self, idx: int) -> None:
@@ -158,7 +158,7 @@ class Log(LogApi):
             for i in range(from_idx, self._last_index + 1):
                 e = self.mt.get(i)
                 if e is not None:
-                    self.wal.write(self.uid, e.index, e.term, pickle.dumps(e.cmd))
+                    self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
             return []
         return []
 

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ra_tpu import counters as ra_counters
 from ra_tpu.log.segment import SegmentWriterHandle
+from ra_tpu.protocol import encode_cmd
 from ra_tpu.log.tables import TableRegistry
 from ra_tpu.utils.seq import Seq
 
@@ -139,7 +140,7 @@ class SegmentWriter:
                     if handle.range:
                         new_refs.append((os.path.basename(handle.path), handle.range))
                     handle = self._roll_segment(uid)
-                handle.append(entry.index, entry.term, pickle.dumps(entry.cmd))
+                handle.append(entry.index, entry.term, encode_cmd(entry.cmd))
                 wrote += 1
             if wrote:
                 handle.sync()

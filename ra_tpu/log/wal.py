@@ -209,14 +209,16 @@ class Wal:
             )
             buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc, len(payload))
             buf += payload
+            seq = self._file_seqs.get(uid, Seq.empty())
             if kind == "s":
+                # sparse writes never imply truncation of higher indexes
                 self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+                self._file_seqs[uid] = seq.add(idx)
             else:
                 self._last_idx[uid] = idx
-            seq = self._file_seqs.get(uid, Seq.empty())
-            if idx <= (seq.last() or 0):
-                seq = seq.limit(idx - 1)  # overwrite rewinds
-            self._file_seqs[uid] = seq.add(idx)
+                if idx <= (seq.last() or 0):
+                    seq = seq.limit(idx - 1)  # overwrite rewinds
+                self._file_seqs[uid] = seq.add(idx)
             written.setdefault((uid, term), []).append(idx)
 
         if buf:

@@ -52,6 +52,18 @@ class Command:
 # -- snapshot metadata -----------------------------------------------------
 
 
+def encode_cmd(cmd: Any) -> bytes:
+    """Serialize a log command for durable storage. Client reply handles
+    (``from_ref``) are process-ephemeral — replies are never re-issued
+    after a restart (same rule as the reference, INTERNALS.md:91-106) —
+    so they are stripped before pickling."""
+    import pickle
+
+    if isinstance(cmd, Command) and cmd.from_ref is not None:
+        cmd = dataclasses.replace(cmd, from_ref=None)
+    return pickle.dumps(cmd)
+
+
 @dataclasses.dataclass(frozen=True)
 class SnapshotMeta:
     index: int
@@ -136,9 +148,20 @@ class InstallSnapshotRpc:
 
 @dataclasses.dataclass(frozen=True)
 class InstallSnapshotResult:
+    """Terminal reply: transfer complete (or stale-term rejection)."""
+
     term: int
     last_index: int
     last_term: int
+
+
+@dataclasses.dataclass(frozen=True)
+class InstallSnapshotAck:
+    """Mid-transfer chunk ack consumed by the sender, not the consensus
+    core."""
+
+    term: int
+    chunk_no: int
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,350 @@
+"""RaNode: one running "system" on one node.
+
+Bundles what the reference's per-system supervision tree owns (reference:
+ra_system_sup -> {ra_log_ets, ra_log_sup {meta, segment writer, wal},
+ra_server_sup_sup} plus ra_directory / ra_system_recover): storage infra
+shared by every group on the node, the server-proc registry, the actor
+scheduler, timers, background workers, client notification routing, the
+node failure detector, and crash-restart supervision for server procs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ra_tpu import counters as ra_counters
+from ra_tpu import effects as fx
+from ra_tpu.directory import Directory
+from ra_tpu.log.log import Log
+from ra_tpu.log.meta_store import FileMeta
+from ra_tpu.log.segment_writer import SegmentWriter
+from ra_tpu.log.tables import TableRegistry
+from ra_tpu.log.wal import Wal
+from ra_tpu.machine import Machine
+from ra_tpu.protocol import DownEvent, FromPeer, LogEvent, ServerId
+from ra_tpu.runtime.proc import ServerProc
+from ra_tpu.runtime.scheduler import Scheduler
+from ra_tpu.runtime.timers import TimerService
+from ra_tpu.runtime.transport import InProcTransport, NodeRegistry, registry as node_registry
+from ra_tpu.server import Server, ServerConfig
+from ra_tpu.system import SystemConfig
+
+
+class Monitors:
+    """watcher server-id -> monitored targets (reference: ra_monitors)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (kind, target) -> {(watcher_sid, component)}
+        self._tab: Dict[Tuple[str, Any], set] = {}
+
+    def add(self, watcher: ServerId, kind: str, target: Any, component: str) -> None:
+        with self._lock:
+            self._tab.setdefault((kind, target), set()).add((watcher, component))
+
+    def remove(self, watcher: ServerId, kind: str, target: Any) -> None:
+        with self._lock:
+            s = self._tab.get((kind, target))
+            if s:
+                self._tab[(kind, target)] = {(w, c) for w, c in s if w != watcher}
+
+    def watchers(self, kind: str, target: Any) -> List[Tuple[ServerId, str]]:
+        return list(self._tab.get((kind, target), ()))
+
+
+class RaNode:
+    def __init__(
+        self,
+        name: str,
+        config: Optional[SystemConfig] = None,
+        nodes: Optional[NodeRegistry] = None,
+        tick_interval_s: float = 0.25,
+        election_timeout_s: float = 0.15,
+        detector_poll_s: float = 0.1,
+        scheduler_workers: int = 4,
+    ):
+        self.name = name
+        self.config = config or SystemConfig(name="default")
+        self.dir = os.path.join(self.config.data_dir, name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.tick_interval_s = tick_interval_s
+        self.election_timeout_s = election_timeout_s
+
+        self.tables = TableRegistry()
+        self.scheduler = Scheduler(workers=scheduler_workers)
+        self.scheduler.on_crash = self._on_actor_crash
+        self.timers = TimerService()
+        self.bg = ThreadPoolExecutor(max_workers=2, thread_name_prefix=f"ra-bg-{name}")
+        self.monitors = Monitors()
+        self.procs: Dict[str, ServerProc] = {}
+        self.ra_state: Dict[str, Tuple[str, str, Any]] = {}
+        self._client_sinks: Dict[Any, Callable[[ServerId, list], None]] = {}
+        self._lock = threading.Lock()
+
+        self.sw = SegmentWriter(
+            os.path.join(self.dir, "data"),
+            self.tables,
+            self._log_notify,
+            max_entries=self.config.segment_max_entries,
+            threaded=True,
+        )
+        self.wal = Wal(
+            os.path.join(self.dir, "wal"),
+            self.tables,
+            self._log_notify,
+            segment_writer=self.sw,
+            max_size_bytes=self.config.wal_max_size_bytes,
+            max_batch_size=self.config.wal_max_batch_size,
+            sync_method=self.config.wal_sync_method,
+            compute_checksums=self.config.wal_compute_checksums,
+            threaded=True,
+        )
+        self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
+        self.directory = Directory(self.meta)
+        self.transport = InProcTransport(name, nodes or node_registry())
+        self.running = True
+        (nodes or node_registry()).register(name, self)
+
+        self._node_status: Dict[str, bool] = {}
+        self._detector_poll_s = detector_poll_s
+        self._detector = threading.Thread(
+            target=self._detect_loop, name=f"ra-detector-{name}", daemon=True
+        )
+        self._detector.start()
+
+        if self.config.server_recovery_strategy == "registered":
+            self.recover_registered()
+
+    # ------------------------------------------------------------------
+    # server lifecycle (reference: ra_server_sup_sup start/restart/delete)
+
+    def start_server(
+        self,
+        name: str,
+        cluster_name: str,
+        machine: Machine,
+        initial_members: Tuple[ServerId, ...],
+        uid: Optional[str] = None,
+        machine_config: Optional[dict] = None,
+    ) -> ServerId:
+        with self._lock:
+            if name in self.procs:
+                raise RuntimeError(f"server {name!r} already running on {self.name}")
+            uid = uid or self.directory.uid_of(name) or f"{cluster_name}_{name}"
+            sid: ServerId = (name, self.name)
+            self.directory.register(uid, name, cluster_name)
+            # persist enough config to restart this server after a crash
+            self.meta.store_sync(
+                uid,
+                "__server_config__",
+                {"name": name, "cluster": cluster_name,
+                 "members": tuple(initial_members),
+                 "machine_config": machine_config or {}},
+            )
+            self._machines = getattr(self, "_machines", {})
+            self._machines[uid] = machine
+            log = Log(
+                uid,
+                os.path.join(self.dir, "data", uid),
+                self.tables,
+                self.wal,
+                min_snapshot_interval=self.config.min_snapshot_interval,
+                min_checkpoint_interval=self.config.min_checkpoint_interval,
+            )
+            cfg = ServerConfig(
+                server_id=sid,
+                uid=uid,
+                cluster_name=cluster_name,
+                machine=machine,
+                initial_members=tuple(initial_members),
+                max_pipeline_count=self.config.default_max_pipeline_count,
+                max_aer_batch_size=self.config.default_max_append_entries_rpc_batch_size,
+                machine_config=machine_config,
+            )
+            server = Server(cfg, log, self.meta)
+            server.recover()
+            proc = ServerProc(self, server)
+            self.procs[name] = proc
+            return sid
+
+    def restart_server(self, name: str) -> ServerId:
+        uid = self.directory.uid_of(name)
+        if uid is None:
+            raise RuntimeError(f"unknown server {name!r}")
+        rec = self.meta.fetch(uid, "__server_config__")
+        machine = getattr(self, "_machines", {}).get(uid)
+        if rec is None or machine is None:
+            raise RuntimeError(f"no persisted config/machine for {name!r}")
+        self.stop_server(name)
+        return self.start_server(
+            name, rec["cluster"], machine, rec["members"], uid=uid,
+            machine_config=rec.get("machine_config"),
+        )
+
+    def stop_server(self, name: str) -> None:
+        with self._lock:
+            proc = self.procs.pop(name, None)
+        if proc is not None:
+            proc.kill()
+            proc.server.log.close()
+            self.ra_state.pop(proc.server.cfg.uid, None)
+            # leader-process monitoring: tell every node this proc died
+            # (the reference's erlang monitors on the leader,
+            # follower_leader_change src/ra_server_proc.erl:1958)
+            sid = proc.server.id
+            for other in list(self.transport.nodes.nodes.values()):
+                try:
+                    other.on_proc_down(sid)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def delete_server(self, name: str) -> None:
+        uid = self.directory.uid_of(name)
+        self.stop_server(name)
+        if uid:
+            self.directory.unregister(uid)
+            self.meta.delete(uid)
+            self.tables.delete_mem_table(uid)
+            self.tables.delete_snapshot_state(uid)
+            shutil.rmtree(os.path.join(self.dir, "data", uid), ignore_errors=True)
+
+    def recover_registered(self) -> None:
+        """server_recovery_strategy=registered: restart every registered
+        server (machines must be re-suppliable via registered factories)."""
+        for uid, name, cluster in self.directory.registered():
+            machine = getattr(self, "_machines", {}).get(uid)
+            rec = self.meta.fetch(uid, "__server_config__")
+            if machine is not None and rec is not None and name not in self.procs:
+                self.start_server(name, cluster, machine, rec["members"], uid=uid)
+
+    def _on_actor_crash(self, actor) -> None:
+        """Supervision: restart a crashed server proc (rest_for_one
+        equivalent for the proc+worker pair)."""
+        name = actor.name
+        try:
+            self.restart_server(name)
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+
+    # ------------------------------------------------------------------
+    # message delivery
+
+    def deliver(self, to: ServerId, msg: Any, from_sid: Optional[ServerId]) -> bool:
+        proc = self.procs.get(to[0])
+        if proc is None:
+            return False
+        proc.enqueue(FromPeer(from_sid, msg) if from_sid is not None else msg)
+        return True
+
+    def _log_notify(self, uid: str, evt: Any) -> None:
+        """Route WAL/segment-writer events to the owning proc."""
+        name = self.directory.name_of(uid)
+        if name is None:
+            return
+        proc = self.procs.get(name)
+        if proc is not None:
+            proc.enqueue(LogEvent(evt))
+
+    # ------------------------------------------------------------------
+    # client plumbing
+
+    def register_client_sink(self, who: Any, cb: Callable[[ServerId, list], None]) -> None:
+        self._client_sinks[who] = cb
+
+    def notify_client(self, who: Any, from_sid: ServerId, correlations: list) -> None:
+        cb = self._client_sinks.get(who)
+        if cb is not None:
+            try:
+                cb(from_sid, correlations)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def send_msg(self, to: Any, msg: Any, options) -> None:
+        cb = self._client_sinks.get(to)
+        if cb is not None:
+            try:
+                cb(None, [msg])
+            except Exception:  # noqa: BLE001
+                pass
+
+    def submit_bg(self, eff: fx.BgWork) -> None:
+        def run():
+            try:
+                eff.fn()
+            except BaseException as e:  # noqa: BLE001
+                if eff.err_fn is not None:
+                    eff.err_fn(e)
+
+        self.bg.submit(run)
+
+    # ------------------------------------------------------------------
+    # failure detection (reference: aten poll-based node suspicion)
+
+    def _detect_loop(self) -> None:
+        import time as _t
+
+        while self.running:
+            try:
+                for other in self.transport.known_nodes():
+                    if other == self.name:
+                        continue
+                    alive = self.transport.node_alive(other)
+                    prev = self._node_status.get(other)
+                    if prev is None:
+                        self._node_status[other] = alive
+                        continue
+                    if prev != alive:
+                        self._node_status[other] = alive
+                        status = "up" if alive else "down"
+                        for proc in list(self.procs.values()):
+                            proc.on_node_event(other, status)
+            except Exception:  # noqa: BLE001
+                pass
+            _t.sleep(self._detector_poll_s)
+
+    def on_proc_down(self, sid: ServerId) -> None:
+        """A proc (possibly remote) died: followers whose leader it was
+        arm election timers; machine monitors fire DownEvents."""
+        from ra_tpu.server import AWAIT_CONDITION, FOLLOWER
+
+        for proc in list(self.procs.values()):
+            srv = proc.server
+            if (
+                srv.leader_id == sid
+                and srv.role in (FOLLOWER, AWAIT_CONDITION)
+                and srv.is_voter_self()
+            ):
+                proc.arm_election_timer()
+        for watcher, component in self.monitors.watchers("process", sid):
+            proc = self.procs.get(watcher[0])
+            if proc is not None:
+                proc.enqueue(DownEvent(sid, "noproc"))
+
+    # ------------------------------------------------------------------
+
+    def overview(self) -> dict:
+        return {
+            "node": self.name,
+            "servers": {
+                uid: {"name": n, "role": r, "leader": l}
+                for uid, (n, r, l) in self.ra_state.items()
+            },
+            "wal": self.wal.overview(),
+        }
+
+    def stop(self) -> None:
+        self.running = False
+        for name in list(self.procs):
+            self.stop_server(name)
+        self.wal.close()
+        self.sw.close()
+        self.meta.close()
+        self.scheduler.close()
+        self.timers.close()
+        self.bg.shutdown(wait=False)
+        self.transport.nodes.unregister(self.name)

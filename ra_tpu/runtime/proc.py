@@ -1,0 +1,476 @@
+"""ServerProc: the runtime shell around one consensus core.
+
+The counterpart of the reference's ``ra_server_proc`` gen_statem
+(``src/ra_server_proc.erl``): owns the mailbox, realises effects
+(sends, replies, vote fan-out, snapshot sender, timers, monitors,
+leaderboard records, background work), manages election/tick timers, and
+batches client commands per mailbox drain (the reference's low-priority
+command queue + AER batching play this role).
+
+Election liveness follows the reference's no-idle-heartbeats design
+(reference: docs/internals/INTERNALS.md:290-327): followers arm a
+randomized election timer only on leader-down evidence (node failure
+detector, leader proc DOWN) and disarm it on any contact from the
+leader; pre-vote/candidate states keep a timer armed to retry stalled
+elections.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ra_tpu import effects as fx
+from ra_tpu import leaderboard
+from ra_tpu.protocol import (
+    AppendEntriesRpc,
+    CHUNK_INIT,
+    CHUNK_LAST,
+    CHUNK_NEXT,
+    CHUNK_PRE,
+    Command,
+    DownEvent,
+    ElectionTimeout,
+    FromPeer,
+    HeartbeatRpc,
+    InstallSnapshotAck,
+    InstallSnapshotResult,
+    InstallSnapshotRpc,
+    LogEvent,
+    NodeEvent,
+    ServerId,
+    Tick,
+)
+from ra_tpu.server import (
+    AWAIT_CONDITION,
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    PRE_VOTE,
+    RECEIVE_SNAPSHOT,
+    Server,
+)
+
+
+class SnapshotSender:
+    """Chunked snapshot sender to one peer (the reference spawns a
+    transient process per transfer: src/ra_server_proc.erl:1691-1735).
+
+    The snapshot payload (meta, pickled-state chunks, live entries) is
+    captured on the owning proc thread *before* this thread starts — the
+    log is single-owner and must not be read concurrently."""
+
+    def __init__(
+        self,
+        proc: "ServerProc",
+        to: ServerId,
+        meta,
+        chunks: List[bytes],
+        live_entries: list,
+        term: int,
+    ):
+        self.proc = proc
+        self.to = to
+        self.meta = meta
+        self.chunks = chunks
+        self.live_entries = live_entries
+        self.term = term
+        self.acks: "threading.Condition" = threading.Condition()
+        self.last_ack: int = -1
+        self.result: Optional[InstallSnapshotResult] = None
+        self.thread = threading.Thread(
+            target=self._run, name=f"ra-snap-send-{to[0]}", daemon=True
+        )
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def on_ack(self, ack: InstallSnapshotAck) -> None:
+        with self.acks:
+            self.last_ack = max(self.last_ack, ack.chunk_no)
+            self.acks.notify()
+
+    def on_result(self, res: InstallSnapshotResult) -> None:
+        with self.acks:
+            self.result = res
+            self.acks.notify()
+
+    def _await_ack(self, chunk_no: int, timeout: float) -> str:
+        """-> "ack" | "result" (terminal reply: stop streaming) |
+        "timeout"."""
+        deadline = time.monotonic() + timeout
+        with self.acks:
+            while True:
+                if self.result is not None:
+                    return "result"
+                if self.last_ack >= chunk_no:
+                    return "ack"
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return "timeout"
+                self.acks.wait(timeout=left)
+
+    def _run(self) -> None:
+        proc = self.proc
+        try:
+            timeout = proc.snapshot_ack_timeout_s
+
+            def send(no, phase, data=b""):
+                proc.transport.send(
+                    self.to,
+                    InstallSnapshotRpc(
+                        term=self.term, leader_id=proc.server.id, meta=self.meta,
+                        chunk_no=no, chunk_phase=phase, data=data,
+                    ),
+                    from_sid=proc.server.id,
+                )
+
+            def finish_on(status) -> bool:
+                if status == "timeout":
+                    proc.enqueue(("snapshot_send_failed", self.to))
+                    return True
+                if status == "result":
+                    # terminal reply mid-transfer (e.g. stale term):
+                    # surface it and stop streaming
+                    proc.enqueue(("snapshot_send_done", self.to, self.result))
+                    return True
+                return False
+
+            send(0, CHUNK_INIT)
+            if finish_on(self._await_ack(0, timeout)):
+                return
+            no = 1
+            if self.live_entries:
+                send(no, CHUNK_PRE, self.live_entries)
+                if finish_on(self._await_ack(no, timeout)):
+                    return
+                no += 1
+            for i, chunk in enumerate(self.chunks):
+                last = i == len(self.chunks) - 1
+                send(no, CHUNK_LAST if last else CHUNK_NEXT, chunk)
+                if last:
+                    break
+                if finish_on(self._await_ack(no, timeout)):
+                    return
+                no += 1
+            # final result arrives as InstallSnapshotResult; wait for it
+            deadline = time.monotonic() + timeout
+            with self.acks:
+                while self.result is None and time.monotonic() < deadline:
+                    self.acks.wait(timeout=0.1)
+            if self.result is None:
+                proc.enqueue(("snapshot_send_failed", self.to))
+            else:
+                proc.enqueue(("snapshot_send_done", self.to, self.result))
+        except Exception:  # noqa: BLE001
+            proc.enqueue(("snapshot_send_failed", self.to))
+
+
+class ServerProc:
+    def __init__(self, node, server: Server):
+        self.node = node
+        self.server = server
+        self.transport = node.transport
+        self.timers = node.timers
+        self.name = server.id[0]
+        self.actor = node.scheduler.actor(self.name, self._on_batch)
+        self.tick_interval_s = node.tick_interval_s
+        self.election_timeout_s = node.election_timeout_s
+        self.snapshot_ack_timeout_s = 120.0
+        self._election_ref: Optional[int] = None
+        self._tick_ref: Optional[int] = None
+        self._senders: Dict[ServerId, SnapshotSender] = {}
+        self._machine_timers: Dict[Any, int] = {}
+        self.running = True
+        self._set_tick_timer()
+        self._update_state_table()
+
+    # ------------------------------------------------------------------
+
+    def enqueue(self, msg: Any, front: bool = False) -> None:
+        self.actor.send(msg, front=front)
+
+    def kill(self) -> None:
+        self.running = False
+        self.timers.cancel(self._tick_ref)
+        self.timers.cancel(self._election_ref)
+        self.actor.kill()
+
+    # ------------------------------------------------------------------
+
+    def _on_batch(self, batch: List[Any]) -> None:
+        server = self.server
+        i = 0
+        n = len(batch)
+        while i < n:
+            msg = batch[i]
+            # coalesce consecutive client commands into one core call
+            if isinstance(msg, Command) and server.role == LEADER:
+                cmds = [msg]
+                while i + 1 < n and isinstance(batch[i + 1], Command):
+                    i += 1
+                    cmds.append(batch[i])
+                effects = server.handle(cmds if len(cmds) > 1 else cmds[0])
+            elif isinstance(msg, tuple) and msg and msg[0] in (
+                "snapshot_send_done",
+                "snapshot_send_failed",
+            ):
+                effects = self._handle_sender_event(msg)
+            elif isinstance(msg, tuple) and msg and msg[0] in (
+                "local_query",
+                "leader_query",
+                "state_query",
+                "consistent_query",
+            ):
+                effects = self._handle_query(msg)
+            elif isinstance(msg, FromPeer) and isinstance(
+                msg.msg, (InstallSnapshotAck, InstallSnapshotResult)
+            ) and msg.peer in self._senders:
+                sender = self._senders[msg.peer]
+                if isinstance(msg.msg, InstallSnapshotAck):
+                    sender.on_ack(msg.msg)
+                else:
+                    sender.on_result(msg.msg)
+                effects = []
+            else:
+                if isinstance(msg, FromPeer):
+                    self._note_contact(msg)
+                elif isinstance(msg, Tick) and server.role == LEADER:
+                    # reconnect probing: peers marked disconnected by
+                    # failed sends are retried once reachable again (the
+                    # reference flips status on nodeup; proc restarts on a
+                    # live node need the same)
+                    for sid, p in server.peers().items():
+                        if p.status == "disconnected" and self.transport.proc_alive(sid):
+                            p.status = "normal"
+                effects = server.handle(msg)
+            self._execute(effects)
+            i += 1
+        self._update_state_table()
+
+    def _note_contact(self, msg: FromPeer) -> None:
+        """A message from a live leader disarms the election timer. A
+        stale in-flight message from an already-dead sender is NOT
+        liveness evidence — without this check a dead leader's last AERs
+        can cancel the armed timer and leave the cluster leaderless."""
+        if (
+            isinstance(msg.msg, (AppendEntriesRpc, InstallSnapshotRpc, HeartbeatRpc))
+            and self.server.role in (FOLLOWER, AWAIT_CONDITION, RECEIVE_SNAPSHOT)
+            and self._election_ref is not None
+            and self.transport.proc_alive(msg.peer)
+        ):
+            self.timers.cancel(self._election_ref)
+            self._election_ref = None
+
+    def _handle_query(self, msg) -> List[fx.Effect]:
+        """Queries served at the proc layer (reference: ra_server_proc
+        query/5 handling — local/leader direct, consistent via the core's
+        heartbeat round)."""
+        server = self.server
+        kind = msg[0]
+        if kind == "consistent_query":
+            _, fn, fut = msg
+            if server.role == LEADER:
+                return server.handle(("consistent_query", fn, fut))
+            self._reply(fut, ("redirect", server.leader_id))
+            return []
+        _, fn, fut = msg
+        if kind == "local_query":
+            self._reply(fut, ("ok", fn(server.machine_state), server.leader_id))
+        elif kind == "state_query":
+            self._reply(fut, ("ok", fn(server), server.leader_id))
+        elif kind == "leader_query":
+            if server.role == LEADER:
+                self._reply(fut, ("ok", fn(server.machine_state), server.id))
+            else:
+                self._reply(fut, ("redirect", server.leader_id))
+        return []
+
+    def _handle_sender_event(self, msg) -> List[fx.Effect]:
+        if msg[0] == "snapshot_send_done":
+            _, to, result = msg
+            self._senders.pop(to, None)
+            return self.server.handle(result, from_peer=to)
+        _, to = msg
+        self._senders.pop(to, None)
+        peer = self.server.cluster.get(to)
+        if peer is not None and peer.status == "sending_snapshot":
+            peer.status = "normal"  # retried on a later pipeline pass
+        return []
+
+    # ------------------------------------------------------------------
+    # effect executor (reference: handle_effects src/ra_server_proc.erl:1530)
+
+    def _execute(self, effects: List[fx.Effect]) -> None:
+        for eff in effects:
+            if isinstance(eff, fx.SendRpc):
+                ok = self.transport.send(eff.to, eff.msg, from_sid=self.server.id)
+                if not ok:
+                    peer = self.server.cluster.get(eff.to)
+                    if peer is not None and peer.status == "normal":
+                        peer.status = "disconnected"
+            elif isinstance(eff, fx.SendVoteRequests):
+                for to, rpc in eff.requests:
+                    self.transport.send(to, rpc, from_sid=self.server.id)
+            elif isinstance(eff, fx.NextEvent):
+                m = eff.msg
+                self.enqueue(m, front=True)
+            elif isinstance(eff, fx.Reply):
+                self._reply(eff.from_ref, eff.reply)
+            elif isinstance(eff, fx.Notify):
+                self.node.notify_client(eff.who, self.server.id, list(eff.correlations))
+            elif isinstance(eff, fx.SendMsg):
+                self.node.send_msg(eff.to, eff.msg, eff.options)
+            elif isinstance(eff, fx.RecordLeader):
+                leaderboard.record(eff.cluster_name, eff.leader, eff.members)
+            elif isinstance(eff, fx.SendSnapshot):
+                self._start_snapshot_sender(eff.to)
+            elif isinstance(eff, fx.StateEnter):
+                self._on_state_enter(eff.role)
+            elif isinstance(eff, fx.Timer):
+                self._machine_timer(eff)
+            elif isinstance(eff, fx.ModCall):
+                try:
+                    eff.fn(*eff.args)
+                except Exception:  # noqa: BLE001
+                    pass
+            elif isinstance(eff, fx.BgWork):
+                self.node.submit_bg(eff)
+            elif isinstance(eff, fx.Monitor):
+                self.node.monitors.add(self.server.id, eff.kind, eff.target, eff.component)
+            elif isinstance(eff, fx.Demonitor):
+                self.node.monitors.remove(self.server.id, eff.kind, eff.target)
+            elif isinstance(eff, fx.LogRead):
+                entries = self.server.log.sparse_read(list(eff.indexes))
+                out = eff.fn(entries)
+                if out is not None:
+                    self.enqueue(out)
+            elif isinstance(eff, fx.Aux):
+                self.enqueue(("aux", "cast", eff.cmd, None))
+
+    def _reply(self, from_ref: Any, reply: Any) -> None:
+        setter = getattr(from_ref, "set_result", None)
+        if setter is not None:
+            setter(reply)
+        elif callable(from_ref):
+            from_ref(reply)
+
+    # ------------------------------------------------------------------
+    # timers
+
+    def _set_tick_timer(self) -> None:
+        if not self.running:
+            return
+        self._tick_ref = self.timers.after(self.tick_interval_s, self._on_tick)
+
+    def _on_tick(self) -> None:
+        if not self.running:
+            return
+        self.enqueue(Tick(now_ms=int(time.time() * 1000)))
+        self._set_tick_timer()
+
+    def arm_election_timer(self, immediate: bool = False) -> None:
+        from ra_tpu.runtime.timers import randomized_election_timeout
+
+        if not self.running:
+            return
+        self.timers.cancel(self._election_ref)
+        delay = 0.0 if immediate else randomized_election_timeout(self.election_timeout_s)
+        self._election_ref = self.timers.after(delay, self._on_election_timeout)
+
+    def _on_election_timeout(self) -> None:
+        self._election_ref = None
+        if self.running:
+            self.enqueue(ElectionTimeout())
+
+    def _on_state_enter(self, role: str) -> None:
+        if role in (PRE_VOTE, CANDIDATE):
+            self.arm_election_timer()  # retry a stalled election round
+        elif role == LEADER:
+            self.timers.cancel(self._election_ref)
+            self._election_ref = None
+        elif role == FOLLOWER:
+            # reverting to follower on a stale message from a dead leader
+            # must keep an election pending, or the cluster livelocks
+            leader = self.server.leader_id
+            if (
+                leader is not None
+                and leader != self.server.id
+                and not self.transport.proc_alive(leader)
+                and self.server.is_voter_self()
+            ):
+                self.arm_election_timer()
+            else:
+                self.timers.cancel(self._election_ref)
+                self._election_ref = None
+
+    def _machine_timer(self, eff: fx.Timer) -> None:
+        old = self._machine_timers.pop(eff.name, None)
+        self.timers.cancel(old)
+        if eff.ms is None:
+            return
+
+        def fire():
+            self._machine_timers.pop(eff.name, None)
+            if self.running and self.server.role == LEADER:
+                from ra_tpu.protocol import USR
+
+                self.enqueue(Command(kind=USR, data=("timeout", eff.name)))
+
+        self._machine_timers[eff.name] = self.timers.after(eff.ms / 1000.0, fire)
+
+    # ------------------------------------------------------------------
+
+    def _start_snapshot_sender(self, to: ServerId) -> None:
+        if to in self._senders:
+            return
+        # capture the payload here, on the proc thread: the log is
+        # single-owner and must not be read from the sender thread
+        got = self.server.log.read_snapshot()
+        if got is None:
+            peer = self.server.cluster.get(to)
+            if peer is not None and peer.status == "sending_snapshot":
+                peer.status = "normal"
+            return
+        meta, state = got
+        import pickle
+
+        blob = pickle.dumps(state)
+        csize = self.node.config.snapshot_chunk_size
+        chunks = [blob[o : o + csize] for o in range(0, max(len(blob), 1), csize)] or [b""]
+        live_entries = (
+            self.server.log.sparse_read(list(meta.live_indexes))
+            if meta.live_indexes
+            else []
+        )
+        sender = SnapshotSender(
+            self, to, meta, chunks, live_entries, self.server.current_term
+        )
+        self._senders[to] = sender
+        sender.start()
+
+    def _update_state_table(self) -> None:
+        self.node.ra_state[self.server.cfg.uid] = (
+            self.name,
+            self.server.role,
+            self.server.leader_id,
+        )
+
+    # ------------------------------------------------------------------
+    # failure-detector input
+
+    def on_node_event(self, node_name: str, status: str) -> None:
+        """Called (via mailbox) when the failure detector flips a node."""
+        srv = self.server
+        if status == "down":
+            leader = srv.leader_id
+            if (
+                srv.role in (FOLLOWER, AWAIT_CONDITION)
+                and leader is not None
+                and leader[1] == node_name
+                and srv.is_voter_self()
+            ):
+                self.arm_election_timer()
+        if srv.role == LEADER:
+            self.enqueue(NodeEvent(node_name, status))

@@ -1,0 +1,74 @@
+"""Timer service: one thread, a heap of (deadline, ref, callback).
+
+Backs election timeouts (randomized tiers), server ticks and machine
+timers — the roles gen_statem timeouts play in the reference
+(reference: election_timeout_action tiers src/ra_server_proc.erl:
+1931-1950, tick timer :1954).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class TimerService:
+    def __init__(self) -> None:
+        self._heap: list = []
+        self._cancelled: set = set()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._refs = itertools.count(1)
+        self._thread = threading.Thread(target=self._run, name="ra-timers", daemon=True)
+        self._thread.start()
+
+    def after(self, delay_s: float, cb: Callable[[], None]) -> int:
+        ref = next(self._refs)
+        with self._cv:
+            heapq.heappush(self._heap, (time.monotonic() + delay_s, ref, cb))
+            self._cv.notify()
+        return ref
+
+    def cancel(self, ref: Optional[int]) -> None:
+        if ref is None:
+            return
+        with self._cv:
+            self._cancelled.add(ref)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._heap and not self._closed:
+                    self._cv.wait(timeout=0.5)
+                if self._closed:
+                    return
+                deadline, ref, cb = self._heap[0]
+                now = time.monotonic()
+                if deadline > now:
+                    self._cv.wait(timeout=min(deadline - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+                if ref in self._cancelled:
+                    self._cancelled.discard(ref)
+                    continue
+            try:
+                cb()
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2)
+
+
+def randomized_election_timeout(base_s: float) -> float:
+    """Randomized timeout so colliding candidates de-synchronize."""
+    return base_s * (1.0 + random.random())

@@ -66,6 +66,7 @@ from ra_tpu.protocol import (
     FromPeer,
     HeartbeatReply,
     HeartbeatRpc,
+    InstallSnapshotAck,
     InstallSnapshotResult,
     InstallSnapshotRpc,
     LogEvent,
@@ -176,6 +177,11 @@ class Server:
         # consistent-query state (leader side)
         self.query_index: int = 0
         self.pending_queries: List[Tuple[int, Any, Callable]] = []
+        # idx -> client reply handle for await_consensus commands. Reply
+        # handles are process-ephemeral and never persisted (entries are
+        # stripped of from_ref on durable write), so the leader keeps
+        # them here until the entry applies or leadership is lost.
+        self.pending_replies: Dict[int, Any] = {}
 
         # receive_snapshot state
         self._snap_accept: Optional[Dict[str, Any]] = None
@@ -334,6 +340,11 @@ class Server:
         if role == FOLLOWER:
             self.votes = set()
             self.pre_votes = set()
+        if prev == LEADER and role != LEADER:
+            # stepping down: outstanding client replies will never be
+            # issued by us — drop the handles so callers time out/retry
+            self.pending_replies.clear()
+            self.pending_queries = []
         if prev != role:
             effects.append(StateEnter(role))
             effects.extend(self.machine.state_enter(role, self.machine_state))
@@ -474,6 +485,8 @@ class Server:
         self._g("last_index", idx)
         if cmd.reply_mode == "after_log_append" and cmd.from_ref is not None:
             effects.append(Reply(cmd.from_ref, ("ok", (idx, self.current_term), self.id)))
+        elif cmd.reply_mode == "await_consensus" and cmd.from_ref is not None:
+            self.pending_replies[idx] = cmd.from_ref
 
     def _append_cluster_cmd(self, cmd: Command, effects: EffectList) -> bool:
         """Returns False when the change must be rejected. Only one
@@ -835,10 +848,10 @@ class Server:
         notify: Dict[Any, List[Any]],
     ) -> None:
         mode = cmd.reply_mode
-        if mode == "await_consensus" and cmd.from_ref is not None:
-            effects.append(
-                Reply(cmd.from_ref, ("ok", reply, self.id))
-            )
+        if mode == "await_consensus":
+            from_ref = cmd.from_ref or self.pending_replies.pop(entry.index, None)
+            if from_ref is not None:
+                effects.append(Reply(from_ref, ("ok", reply, self.id)))
         elif isinstance(mode, tuple) and mode and mode[0] == "notify":
             _, corr, who = mode
             notify.setdefault(who, []).append((corr, reply))
@@ -1265,10 +1278,7 @@ class Server:
             if msg.chunk_phase == CHUNK_INIT:
                 acc["next_chunk"] = 1
                 effects.append(
-                    SendRpc(
-                        from_peer,
-                        InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
-                    )
+                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
                 )
                 return effects
             if msg.chunk_phase == CHUNK_PRE:
@@ -1280,10 +1290,7 @@ class Server:
                     if self.log.fetch_term(e.index) is None:
                         self.log.write_sparse(e)
                 effects.append(
-                    SendRpc(
-                        from_peer,
-                        InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
-                    )
+                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
                 )
                 return effects
             # next / last: validate chunk ordering — duplicates (sender
@@ -1291,12 +1298,7 @@ class Server:
             # future chunks are ignored so the sender retries in order
             if msg.chunk_no < acc["next_chunk"]:
                 effects.append(
-                    SendRpc(
-                        from_peer,
-                        InstallSnapshotResult(
-                            self.current_term, msg.meta.index, msg.meta.term
-                        ),
-                    )
+                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
                 )
                 return effects
             if msg.chunk_no > acc["next_chunk"]:
@@ -1306,10 +1308,7 @@ class Server:
             if msg.chunk_phase == CHUNK_LAST:
                 return self._complete_snapshot(msg, from_peer, effects)
             effects.append(
-                SendRpc(
-                    from_peer,
-                    InstallSnapshotResult(self.current_term, msg.meta.index, msg.meta.term),
-                )
+                SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
             )
             return effects
         if isinstance(msg, ElectionTimeout):

@@ -562,9 +562,11 @@ def test_snapshot_install_full_flow():
     effects = s.handle(rpc_init, from_peer=S1)
     assert s.role == RECEIVE_SNAPSHOT
     # harness-style: next event redelivers; emulate manually
+    from ra_tpu.protocol import InstallSnapshotAck
+
     effects = s.handle(rpc_init, from_peer=S1)
     res = [e.msg for e in effects if isinstance(e, SendRpc)][-1]
-    assert isinstance(res, InstallSnapshotResult)
+    assert isinstance(res, InstallSnapshotAck)  # mid-transfer chunk ack
     rpc_last = InstallSnapshotRpc(term=3, leader_id=S1, meta=meta, chunk_no=1,
                                   chunk_phase=CHUNK_LAST, data=777)
     effects = s.handle(rpc_last, from_peer=S1)
