@@ -1,19 +1,22 @@
-"""Benchmark: vectorized multi-raft consensus decision throughput.
+"""Benchmark: multi-raft throughput on the tpu_batch coordinator backend.
 
-Measures the TPU hot path of the framework — the fused per-group
-consensus decision step (AppendEntries accept + vote grant + match_index
-quorum commit scan) over BASELINE.json's headline configuration of
-10k raft groups x 3 replicas — and prints ONE JSON line.
+Headline (default): end-to-end replicated commands/sec — G raft groups x
+3 replicas spread over three batch coordinators in this process, no-op
+machine (the reference ra_bench workload shape: src/ra_bench.erl),
+commands pipelined to every group leader, measured until every group has
+applied everything. This exercises the whole pipeline: host append ->
+device decision steps (AER accept / reply bookkeeping / quorum scan,
+fused over all groups) -> follower accept -> commit -> apply.
 
-The reference publishes no benchmark numbers (BASELINE.md: published={}).
-``vs_baseline`` therefore compares against the reference harness's
-*driver target rate* of 100,000 ops/sec (reference: src/ra_bench.erl:38,
-the only quantitative throughput anchor the reference ships): the number
-of consensus decisions/sec the device path sustains divided by 100k.
-This is the decision-kernel ceiling, not yet end-to-end commands/sec;
-the full-pipeline bench lands with the batch coordinator backend.
+``--decisions`` instead measures the raw fused decision-kernel
+throughput at 10k groups (the device ceiling, no host routing).
 
-Usage: python bench.py [--smoke]
+The reference publishes no benchmark numbers (BASELINE.md: published={});
+``vs_baseline`` compares against the reference harness's driver target
+rate of 100,000 ops/sec (src/ra_bench.erl:38), the only quantitative
+throughput anchor it ships.
+
+Output: ONE JSON line {metric, value, unit, vs_baseline}.
 """
 
 import argparse
@@ -21,13 +24,91 @@ import json
 import time
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--smoke", action="store_true", help="small/fast run")
-    ap.add_argument("--groups", type=int, default=None)
-    ap.add_argument("--steps", type=int, default=None)
-    args = ap.parse_args()
+def bench_pipeline(groups: int, cmds: int) -> dict:
+    from ra_tpu.machine import SimpleMachine
+    from ra_tpu.ops import consensus as C
+    from ra_tpu.protocol import Command, ElectionTimeout, USR
+    from ra_tpu.runtime.coordinator import BatchCoordinator
 
+    coords = [
+        BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3, idle_sleep_s=0)
+        for i in range(3)
+    ]
+    for c in coords:
+        c.start()
+    try:
+        members = lambda g: [(f"g{g}", f"bench{i}") for i in range(3)]  # noqa: E731
+        for g in range(groups):
+            for c in coords:
+                c.add_group(
+                    f"g{g}", f"cl{g}", members(g), SimpleMachine(lambda x, s: s + x, 0)
+                )
+        for g in range(groups):
+            coords[0].deliver((f"g{g}", "bench0"), ElectionTimeout(), None)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if all(
+                coords[0].by_name[f"g{g}"].role == C.R_LEADER for g in range(groups)
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            pass
+        if not all(
+            coords[0].by_name[f"g{g}"].role == C.R_LEADER for g in range(groups)
+        ):
+            import sys
+
+            print("bench error: leader election incomplete", file=sys.stderr)
+            raise SystemExit(1)
+
+        t0 = time.perf_counter()
+        for _ in range(cmds):
+            for g in range(groups):
+                coords[0].deliver(
+                    (f"g{g}", "bench0"),
+                    Command(kind=USR, data=1, reply_mode="noreply"),
+                    None,
+                )
+        while time.time() < deadline:
+            if all(
+                coords[0].by_name[f"g{g}"].machine_state == cmds
+                for g in range(groups)
+            ):
+                break
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        if not all(
+            coords[0].by_name[f"g{g}"].machine_state == cmds for g in range(groups)
+        ):
+            import sys
+
+            done = sum(
+                coords[0].by_name[f"g{g}"].machine_state == cmds
+                for g in range(groups)
+            )
+            print(
+                f"bench error: only {done}/{groups} groups completed", file=sys.stderr
+            )
+            raise SystemExit(1)
+        total = groups * cmds
+        import jax
+
+        return {
+            "metric": (
+                f"replicated commands/sec ({groups} groups x 3 replicas, "
+                f"tpu_batch coordinators, device {jax.devices()[0].platform})"
+            ),
+            "value": round(total / dt, 1),
+            "unit": "commands/sec",
+            "vs_baseline": round(total / dt / 100_000.0, 3),
+        }
+    finally:
+        for c in coords:
+            c.stop()
+
+
+def bench_decisions(groups: int, steps: int) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -38,56 +119,58 @@ def main() -> None:
         make_group_state,
     )
 
-    G = args.groups or (1024 if args.smoke else 10240)
-    T = args.steps or (10 if args.smoke else 200)
-    P = 3
-
-    state = make_group_state(G, P)
+    G, T = groups, steps
+    state = make_group_state(G, 3)
     mbox = empty_mailbox(G)._replace(
         msg_type=jnp.full((G,), MSG_AER, jnp.int32),
         term=jnp.ones((G,), jnp.int32),
-        prev_idx=jnp.zeros((G,), jnp.int32),
-        prev_term=jnp.zeros((G,), jnp.int32),
         num_entries=jnp.ones((G,), jnp.int32),
         entries_last_term=jnp.ones((G,), jnp.int32),
-        leader_commit=jnp.zeros((G,), jnp.int32),
     )
 
     def many_steps(state, mbox):
         def body(st, _):
-            # sustained append load: every step carries one new entry per
-            # group, prev-matched against the current tail, so the ring
-            # buffer, tail bookkeeping and accept path all do real work
             mb = mbox._replace(prev_idx=st.last_index, prev_term=st.last_term)
             st2, eg = consensus_step_impl(st, mb)
             return st2, eg.success.sum()
 
-        st, sums = jax.lax.scan(body, state, None, length=T)
-        return st, sums
+        return jax.lax.scan(body, state, None, length=T)
 
     run = jax.jit(many_steps, donate_argnums=(0,))
-    # warmup/compile
     st, sums = run(jax.tree.map(jnp.copy, state), mbox)
     jax.block_until_ready(sums)
-
     t0 = time.perf_counter()
     st, sums = run(jax.tree.map(jnp.copy, state), mbox)
     jax.block_until_ready(sums)
     dt = time.perf_counter() - t0
+    return {
+        "metric": (
+            f"consensus decisions/sec (fused device step, {G} groups x 3 "
+            f"replicas, device {jax.devices()[0].platform})"
+        ),
+        "value": round(G * T / dt, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(G * T / dt / 100_000.0, 2),
+    }
 
-    decisions_per_sec = (G * T) / dt
-    print(
-        json.dumps(
-            {
-                "metric": "consensus decisions/sec (fused AER-accept + vote + "
-                f"quorum-scan step, {G} groups x {P} replicas, device "
-                f"{jax.devices()[0].platform})",
-                "value": round(decisions_per_sec, 1),
-                "unit": "decisions/sec",
-                "vs_baseline": round(decisions_per_sec / 100_000.0, 2),
-            }
-        )
-    )
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small/fast run")
+    ap.add_argument("--decisions", action="store_true",
+                    help="raw decision-kernel throughput instead of pipeline")
+    ap.add_argument("--groups", type=int, default=None)
+    ap.add_argument("--cmds", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.decisions:
+        g = args.groups or (1024 if args.smoke else 10240)
+        out = bench_decisions(g, args.steps or (10 if args.smoke else 200))
+    else:
+        g = args.groups or (128 if args.smoke else 2048)
+        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 5))
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

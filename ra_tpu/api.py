@@ -173,9 +173,11 @@ def process_command(
             target = _next_target(server_id, target, tried)
             continue
         try:
-            # bounded per-attempt wait: a stale/partitioned leader may
-            # never answer
-            attempt = min(1.0, max(0.05, deadline - time.monotonic()))
+            remaining = max(0.05, deadline - time.monotonic())
+            # without retries the caller's full timeout applies to this
+            # attempt; with retries each attempt is bounded so a stale/
+            # partitioned leader cannot absorb the whole deadline
+            attempt = min(1.0, remaining) if retry_on_timeout else remaining
             reply = fut.result(timeout=attempt)
         except TimeoutError:
             if not retry_on_timeout:

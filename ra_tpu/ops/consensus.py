@@ -111,6 +111,11 @@ class Mailbox(NamedTuple):
     cand_last_idx: jax.Array  # i32[G]
     cand_last_term: jax.Array  # i32[G]
     cand_machine_version: jax.Array  # i32[G]
+    # host-resolved term cache: when a previous step flagged needs_host,
+    # the host re-submits the message with the term it read from its log
+    # at host_term_idx (-1 = no override)
+    host_term_idx: jax.Array  # i32[G]
+    host_term_val: jax.Array  # i32[G]
 
 
 class Egress(NamedTuple):
@@ -130,6 +135,10 @@ class Egress(NamedTuple):
     commit_advanced_to: jax.Array  # i32[G] new commit index (== old if not)
     needs_host: jax.Array  # bool[G] fall back to scalar oracle
     term_or_vote_changed: jax.Array  # bool[G] host must persist term/vote
+    # post-step mirror for the host (role/leader/current term/agreed idx)
+    role: jax.Array  # i32[G]
+    leader_slot: jax.Array  # i32[G]
+    agreed_idx: jax.Array  # i32[G] quorum match point (for host term lookup)
 
 
 def make_group_state(num_groups: int, num_peers: int, suffix_k: int = 32) -> GroupState:
@@ -181,6 +190,8 @@ def empty_mailbox(num_groups: int) -> Mailbox:
         cand_last_idx=zi(),
         cand_last_term=zi(),
         cand_machine_version=zi(),
+        host_term_idx=jnp.full((g,), -1, jnp.int32),
+        host_term_val=jnp.full((g,), -1, jnp.int32),
     )
 
 
@@ -243,6 +254,10 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
 
     # ---------------- AER (follower accept path) ----------------
     local_prev_term, prev_known = term_at(state, mbox.prev_idx)
+    # host-resolved override (deep backfill outside the device window)
+    prev_override = (mbox.host_term_idx == mbox.prev_idx) & (mbox.host_term_val >= 0)
+    local_prev_term = jnp.where(prev_override, mbox.host_term_val, local_prev_term)
+    prev_known = prev_known | prev_override
     aer_stale = mbox.term < term1
     aer_behind = mbox.prev_idx < state.snapshot_index
     aer_match = prev_known & (local_prev_term == mbox.prev_term)
@@ -407,6 +422,9 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
         ),
         agreed,
     )
+    agreed_override = (mbox.host_term_idx == agreed) & (mbox.host_term_val >= 0)
+    agreed_term = jnp.where(agreed_override, mbox.host_term_val, agreed_term)
+    agreed_known = agreed_known | agreed_override
     can_commit = (
         (role3 == R_LEADER)
         & (agreed > commit2)
@@ -445,6 +463,9 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
         commit_advanced_to=commit3,
         needs_host=aer_needs_host | quorum_needs_host,
         term_or_vote_changed=(term2 != term0) | (voted3 != voted0),
+        role=role3,
+        leader_slot=leader4,
+        agreed_idx=agreed,
     )
     new_state = state._replace(
         current_term=term2,
@@ -507,3 +528,15 @@ def record_appended(
 def record_written(state: GroupState, group_ids: jax.Array, idxs: jax.Array) -> GroupState:
     """Advance durable watermarks after WAL fsync."""
     return state._replace(written_index=state.written_index.at[group_ids].max(idxs))
+
+
+@jax.jit
+def set_roles(state: GroupState, group_ids: jax.Array, roles: jax.Array) -> GroupState:
+    """Host-driven role transitions (election initiation and similar rare
+    paths): scatter new roles and clear election tallies for the named
+    groups."""
+    role = state.role.at[group_ids].set(roles)
+    touched = jnp.zeros_like(state.role, dtype=jnp.bool_).at[group_ids].set(True)
+    votes = jnp.where(touched[:, None], False, state.votes)
+    pre_votes = jnp.where(touched[:, None], False, state.pre_votes)
+    return state._replace(role=role, votes=votes, pre_votes=pre_votes)

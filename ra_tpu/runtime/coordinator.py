@@ -1,0 +1,802 @@
+"""Batch coordinator: many raft groups stepped together on the device.
+
+The framework's north-star execution backend (``server_impl =
+"tpu_batch"``): instead of one actor per group, one coordinator owns the
+consensus decision state of *all* its groups as device arrays
+(``ra_tpu.ops.consensus.GroupState``) and advances them in fused steps —
+one ``consensus_step`` call classifies up to one inbound message per
+group and runs every group's quorum scan at once.
+
+Division of labor (keeps host<->device traffic to one egress struct per
+step):
+
+- **device (authoritative)**: current_term, voted_for, role, votes,
+  match_index, commit_index, log-tail bookkeeping + recent-term ring;
+- **host (authoritative)**: log *contents* (WAL/memtable/segments),
+  machine apply, client replies, outbound AER construction with its own
+  ``next_index`` bookkeeping (host routes every inbound reply anyway, so
+  both sides update their own variables from the same messages — no
+  gathers needed);
+- **rare paths** (election initiation, deep-backfill term lookups) run
+  host-side against the post-step egress mirror, re-entering the device
+  via scatters (``set_roles``/``record_appended``) and mailbox term
+  overrides.
+
+The coordinator registers in the node registry and speaks the same
+transport/protocol as per-group ServerProcs, so batch-backed and
+actor-backed members interoperate in one cluster. Replies leaving a step
+are batched per destination node — thousands of groups' traffic rides
+single transport hops.
+
+Round-1 scope note: snapshot install/send for batch-backed groups falls
+back to... not implemented yet — groups needing snapshot catch-up should
+run on the actor backend (documented gap, see SURVEY §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ra_tpu import leaderboard
+from ra_tpu.log.api import LogApi
+from ra_tpu.log.memory import MemoryLog
+from ra_tpu.machine import Machine, normalize_apply_result
+from ra_tpu.ops import consensus as C
+from ra_tpu.protocol import (
+    AppendEntriesReply,
+    AppendEntriesRpc,
+    Command,
+    ElectionTimeout,
+    Entry,
+    FromPeer,
+    NOOP,
+    PreVoteResult,
+    PreVoteRpc,
+    RequestVoteResult,
+    RequestVoteRpc,
+    ServerId,
+    USR,
+)
+from ra_tpu.runtime.transport import InProcTransport, NodeRegistry, registry as node_registry
+
+MSG_OF_TYPE = {
+    AppendEntriesRpc: C.MSG_AER,
+    AppendEntriesReply: C.MSG_AER_REPLY,
+    RequestVoteRpc: C.MSG_VOTE_REQ,
+    RequestVoteResult: C.MSG_VOTE_REPLY,
+    PreVoteRpc: C.MSG_PREVOTE_REQ,
+    PreVoteResult: C.MSG_PREVOTE_REPLY,
+}
+
+
+class GroupHost:
+    """Host-side companion of one device-resident group."""
+
+    __slots__ = (
+        "gid", "name", "cluster_name", "members", "self_slot", "log",
+        "machine", "machine_state", "last_applied", "role", "term",
+        "leader_slot", "next_index", "commit_sent", "pending_replies",
+        "inbox", "host_term_hint", "election_ref", "effective_machine_version",
+        "pending_ack",
+    )
+
+    def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
+        self.gid = gid
+        self.name = name
+        self.cluster_name = cluster_name
+        self.members: List[ServerId] = list(members)
+        self.self_slot = self_slot
+        self.log: LogApi = log
+        self.machine: Machine = machine
+        self.machine_state = machine.init({"name": cluster_name})
+        self.effective_machine_version = 0
+        self.last_applied = 0
+        self.role = C.R_FOLLOWER
+        self.term = 0
+        self.leader_slot = -1
+        self.next_index = [1] * len(self.members)
+        self.commit_sent = [0] * len(self.members)
+        self.pending_replies: Dict[int, Any] = {}
+        self.inbox: deque = deque()
+        self.host_term_hint: Optional[Tuple[int, int]] = None
+        self.election_ref = None
+        # deferred AER ack awaiting WAL durability: (leader_sid, up_to_idx)
+        self.pending_ack: Optional[Tuple[ServerId, int]] = None
+
+    def slot_of(self, sid: ServerId) -> int:
+        try:
+            return self.members.index(sid)
+        except ValueError:
+            return -1
+
+    def sid_of(self, slot: int) -> Optional[ServerId]:
+        if 0 <= slot < len(self.members):
+            return self.members[slot]
+        return None
+
+
+class BatchCoordinator:
+    """Hosts up to ``capacity`` groups on one node, device-stepped."""
+
+    def __init__(
+        self,
+        node_name: str,
+        capacity: int = 1024,
+        num_peers: int = 3,
+        suffix_k: int = 32,
+        nodes: Optional[NodeRegistry] = None,
+        aer_batch_size: int = 128,
+        election_timeout_s: float = 0.15,
+        detector_poll_s: float = 0.1,
+        meta=None,
+        idle_sleep_s: float = 0.0005,
+    ):
+        self.name = node_name
+        self.capacity = capacity
+        self.P = num_peers
+        self.aer_batch_size = aer_batch_size
+        self.election_timeout_s = election_timeout_s
+        self.meta = meta
+        self.idle_sleep_s = idle_sleep_s
+
+        self.state = C.make_group_state(capacity, num_peers, suffix_k)
+        # groups not yet registered must never act: mark inactive
+        self.state = self.state._replace(
+            active=jnp.zeros((capacity, num_peers), dtype=jnp.bool_),
+            voting=jnp.zeros((capacity, num_peers), dtype=jnp.bool_),
+        )
+        self.groups: List[Optional[GroupHost]] = [None] * capacity
+        self.by_name: Dict[str, GroupHost] = {}
+        self.n_groups = 0
+
+        self._ingress: deque = deque()
+        self._ingress_cv = threading.Condition()
+        self._pending_scatters: List[Tuple[str, int, int, int]] = []
+        self._hot: set = set()  # gids with queued inbox msgs / term hints
+        self._applied_np = np.zeros(capacity, np.int64)  # last_applied mirror
+        # guards self.state (donated buffers!) between the step thread and
+        # add_group callers
+        self._state_lock = threading.Lock()
+
+        self.registry = nodes or node_registry()
+        self.transport = InProcTransport(node_name, self.registry)
+        self.running = True
+        self.registry.register(node_name, self)
+        self.steps = 0
+        self.msgs_processed = 0
+
+        self._step_thread = threading.Thread(
+            target=self._run, name=f"ra-batch-{node_name}", daemon=True
+        )
+        self._node_status: Dict[str, bool] = {}
+        self._detector_poll_s = detector_poll_s
+        self._detector = threading.Thread(
+            target=self._detect_loop, name=f"ra-batch-det-{node_name}", daemon=True
+        )
+        self._started = False
+
+    # -- node-registry interface (same duck type as RaNode) ---------------
+
+    @property
+    def procs(self) -> Dict[str, Any]:
+        return self.by_name
+
+    def deliver(self, to: ServerId, msg: Any, from_sid: Optional[ServerId]) -> bool:
+        g = self.by_name.get(to[0])
+        if g is None:
+            return False
+        with self._ingress_cv:
+            self._ingress.append((to[0], from_sid, msg))
+            self._ingress_cv.notify()
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._step_thread.start()
+            self._detector.start()
+
+    def stop(self) -> None:
+        self.running = False
+        if self._started:
+            self._step_thread.join(timeout=5)
+        self.registry.unregister(self.name)
+
+    def add_group(
+        self,
+        name: str,
+        cluster_name: str,
+        members: List[ServerId],
+        machine: Machine,
+        log: Optional[LogApi] = None,
+    ) -> ServerId:
+        if len(members) > self.P:
+            raise ValueError(f"group has {len(members)} members; capacity is {self.P}")
+        if self.n_groups >= self.capacity:
+            raise RuntimeError("coordinator at capacity")
+        sid = (name, self.name)
+        if sid not in members:
+            raise ValueError("members must include this coordinator's server id")
+        gid = self.n_groups
+        self.n_groups += 1
+        g = GroupHost(
+            gid, name, cluster_name, members, members.index(sid),
+            log or MemoryLog(auto_written=True), machine,
+        )
+        self.groups[gid] = g
+        # activate slots on device
+        active = np.zeros(self.P, dtype=bool)
+        active[: len(members)] = True
+        with self._state_lock:
+            self.state = self.state._replace(
+                active=self.state.active.at[gid].set(jnp.asarray(active)),
+                voting=self.state.voting.at[gid].set(jnp.asarray(active)),
+                self_slot=self.state.self_slot.at[gid].set(g.self_slot),
+            )
+        self.by_name[name] = g
+        return sid
+
+    # -- the step loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        while self.running:
+            worked = self.step_once()
+            if not worked:
+                with self._ingress_cv:
+                    if not self._ingress:
+                        self._ingress_cv.wait(timeout=0.05)
+
+    def step_once(self) -> bool:
+        """One coordinator iteration: drain ingress, scatter host log
+        updates, run the fused device step, realise egress. Returns
+        False when there was nothing to do."""
+        with self._state_lock:
+            return self._step_once_locked()
+
+    def _step_once_locked(self) -> bool:
+        with self._ingress_cv:
+            batch = list(self._ingress)
+            self._ingress.clear()
+        rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = []
+        appended: List[Tuple[int, int, int]] = []  # gid, idx, term
+        written: List[Tuple[int, int]] = []
+        aer_dirty: set = set()
+
+        for to_name, from_sid, msg in batch:
+            g = self.by_name.get(to_name)
+            if g is None:
+                continue
+            self._route_one(g, from_sid, msg, rare, appended, written, aer_dirty)
+
+        if not (batch or self._hot or rare or appended or written or self._pending_scatters):
+            return False
+
+        appended.extend(
+            (gid, idx, term) for kind, gid, idx, term in self._pending_scatters if kind == "a"
+        )
+        written.extend(
+            (gid, idx) for kind, gid, idx, _ in self._pending_scatters if kind == "w"
+        )
+        self._pending_scatters = []
+
+        if appended:
+            gids = jnp.asarray([a[0] for a in appended], jnp.int32)
+            idxs = jnp.asarray([a[1] for a in appended], jnp.int32)
+            terms = jnp.asarray([a[2] for a in appended], jnp.int32)
+            self.state = C.record_appended(self.state, gids, idxs, terms)
+        if written:
+            gids = jnp.asarray([w[0] for w in written], jnp.int32)
+            idxs = jnp.asarray([w[1] for w in written], jnp.int32)
+            self.state = C.record_written(self.state, gids, idxs)
+
+        mbox, consumed = self._build_mailbox()
+        self.state, egress = C.consensus_step(self.state, mbox)
+        eg = {k: np.asarray(v) for k, v in egress._asdict().items()}
+        self.steps += 1
+        self.msgs_processed += len(consumed)
+        self._process_egress(eg, consumed, aer_dirty)
+
+        for g, msg, from_sid in rare:
+            self._handle_rare(g, msg, from_sid)
+        self._send_aers(aer_dirty)
+        return True
+
+    # -- ingress routing ---------------------------------------------------
+
+    def _route_one(self, g: GroupHost, from_sid, msg, rare, appended, written, aer_dirty):
+        if isinstance(msg, FromPeer):
+            from_sid, msg = msg.peer, msg.msg
+        t = type(msg)
+        if t in MSG_OF_TYPE:
+            # host-side next_index bookkeeping rides on the same replies
+            # the device will process
+            if isinstance(msg, AppendEntriesReply) and g.role == C.R_LEADER:
+                slot = g.slot_of(from_sid)
+                if slot >= 0:
+                    if msg.success:
+                        g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
+                    else:
+                        hint = max(1, min(msg.next_index, msg.last_index + 1))
+                        g.next_index[slot] = min(g.next_index[slot], hint)
+                    aer_dirty.add(g.gid)
+            g.inbox.append((from_sid, msg))
+            self._hot.add(g.gid)
+            return
+        if isinstance(msg, Command):
+            self._handle_command(g, msg, appended, written, aer_dirty)
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "log_event":
+            _, evt = msg
+            g.log.handle_event(evt)
+            wi, wt = g.log.last_written()
+            written.append((g.gid, wi))
+            aer_dirty.add(g.gid)
+            if g.pending_ack is not None and wi >= g.pending_ack[1]:
+                leader_sid = g.pending_ack[0]
+                g.pending_ack = None
+                self._send_batch(
+                    leader_sid[1],
+                    [(leader_sid,
+                      AppendEntriesReply(g.term, True, wi + 1, wi, wt),
+                      (g.name, self.name))],
+                )
+            return
+        rare.append((g, msg, from_sid))
+
+    def _handle_command(self, g: GroupHost, cmd: Command, appended, written, aer_dirty):
+        if g.role != C.R_LEADER:
+            if cmd.from_ref is not None:
+                self._reply(cmd.from_ref, ("redirect", g.sid_of(g.leader_slot)))
+            return
+        idx = g.log.next_index()
+        entry = Entry(index=idx, term=g.term, cmd=cmd)
+        g.log.append(entry)
+        appended.append((g.gid, idx, g.term))
+        wi, _ = g.log.last_written()
+        if wi >= idx:
+            written.append((g.gid, idx))
+        if cmd.reply_mode == "after_log_append" and cmd.from_ref is not None:
+            self._reply(cmd.from_ref, ("ok", (idx, g.term), (g.name, self.name)))
+        elif cmd.reply_mode == "await_consensus" and cmd.from_ref is not None:
+            g.pending_replies[idx] = cmd.from_ref
+        aer_dirty.add(g.gid)
+
+    # -- mailbox build -----------------------------------------------------
+
+    def _build_mailbox(self):
+        cap = self.capacity
+        cols = {
+            "msg_type": np.zeros(cap, np.int32),
+            "sender_slot": np.zeros(cap, np.int32),
+            "term": np.zeros(cap, np.int32),
+            "prev_idx": np.zeros(cap, np.int32),
+            "prev_term": np.zeros(cap, np.int32),
+            "num_entries": np.zeros(cap, np.int32),
+            "entries_last_term": np.zeros(cap, np.int32),
+            "leader_commit": np.zeros(cap, np.int32),
+            "success": np.zeros(cap, bool),
+            "reply_next_idx": np.zeros(cap, np.int32),
+            "reply_last_idx": np.zeros(cap, np.int32),
+            "reply_last_term": np.zeros(cap, np.int32),
+            "cand_last_idx": np.zeros(cap, np.int32),
+            "cand_last_term": np.zeros(cap, np.int32),
+            "cand_machine_version": np.zeros(cap, np.int32),
+            "host_term_idx": np.full(cap, -1, np.int32),
+            "host_term_val": np.full(cap, -1, np.int32),
+        }
+        consumed: Dict[int, Tuple[Any, Any]] = {}
+        hot = self._hot
+        self._hot = set()
+        for i in hot:
+            g = self.groups[i]
+            if g is None:
+                continue
+            if g.host_term_hint is not None:
+                cols["host_term_idx"][i], cols["host_term_val"][i] = g.host_term_hint
+                g.host_term_hint = None
+            if not g.inbox:
+                continue
+            from_sid, msg = g.inbox.popleft()
+            consumed[i] = (from_sid, msg)
+            self._encode(g, from_sid, msg, cols, i)
+            if g.inbox:
+                self._hot.add(i)  # more queued: stay hot for next step
+        mbox = C.Mailbox(**{k: jnp.asarray(v) for k, v in cols.items()})
+        return mbox, consumed
+
+    def _encode(self, g: GroupHost, from_sid, msg, cols, i) -> None:
+        cols["sender_slot"][i] = g.slot_of(from_sid) if from_sid else 0
+        if isinstance(msg, AppendEntriesRpc):
+            cols["msg_type"][i] = C.MSG_AER
+            cols["term"][i] = msg.term
+            cols["prev_idx"][i] = msg.prev_log_index
+            cols["prev_term"][i] = msg.prev_log_term
+            cols["num_entries"][i] = len(msg.entries)
+            cols["entries_last_term"][i] = (
+                msg.entries[-1].term if msg.entries else 0
+            )
+            cols["leader_commit"][i] = msg.leader_commit
+        elif isinstance(msg, AppendEntriesReply):
+            cols["msg_type"][i] = C.MSG_AER_REPLY
+            cols["term"][i] = msg.term
+            cols["success"][i] = msg.success
+            cols["reply_next_idx"][i] = msg.next_index
+            cols["reply_last_idx"][i] = msg.last_index
+            cols["reply_last_term"][i] = msg.last_term
+        elif isinstance(msg, RequestVoteRpc):
+            cols["msg_type"][i] = C.MSG_VOTE_REQ
+            cols["term"][i] = msg.term
+            cols["sender_slot"][i] = g.slot_of(msg.candidate_id)
+            cols["cand_last_idx"][i] = msg.last_log_index
+            cols["cand_last_term"][i] = msg.last_log_term
+        elif isinstance(msg, RequestVoteResult):
+            cols["msg_type"][i] = C.MSG_VOTE_REPLY
+            cols["term"][i] = msg.term
+            cols["success"][i] = msg.vote_granted
+        elif isinstance(msg, PreVoteRpc):
+            cols["msg_type"][i] = C.MSG_PREVOTE_REQ
+            cols["term"][i] = msg.term
+            cols["sender_slot"][i] = g.slot_of(msg.candidate_id)
+            cols["cand_last_idx"][i] = msg.last_log_index
+            cols["cand_last_term"][i] = msg.last_log_term
+            cols["cand_machine_version"][i] = msg.machine_version
+        elif isinstance(msg, PreVoteResult):
+            cols["msg_type"][i] = C.MSG_PREVOTE_REPLY
+            cols["term"][i] = msg.term
+            cols["success"][i] = msg.vote_granted
+
+    # -- egress ------------------------------------------------------------
+
+    def _process_egress(self, eg, consumed, aer_dirty) -> None:
+        outbound: Dict[str, List[Tuple[ServerId, Any, ServerId]]] = {}
+
+        def queue_send(to: ServerId, msg: Any, frm: ServerId):
+            outbound.setdefault(to[1], []).append((to, msg, frm))
+
+        for i, (from_sid, msg) in consumed.items():
+            g = self.groups[i]
+            if g is None:
+                continue
+            if isinstance(msg, AppendEntriesRpc):
+                if eg["needs_host"][i]:
+                    self._host_resolve_aer(g, from_sid, msg, queue_send)
+                elif eg["aer_code"][i] == C.AER_OK:
+                    # the host performs the write and owns the durable
+                    # watermark, so it builds the success ack (possibly
+                    # deferred until WAL fsync)
+                    self._host_write_entries(g, msg)
+                    self._ack_aer(g, from_sid, msg, int(eg["term"][i]), queue_send)
+                elif eg["send_reply"][i] and from_sid is not None:
+                    reply = self._build_reply(g, msg, eg, i)
+                    if reply is not None:
+                        queue_send(from_sid, reply, (g.name, self.name))
+            elif eg["send_reply"][i] and from_sid is not None:
+                reply = self._build_reply(g, msg, eg, i)
+                if reply is not None:
+                    queue_send(from_sid, reply, (g.name, self.name))
+
+        # vectorized change detection: only touched groups pay Python cost
+        n = self.n_groups
+        applied = self._applied_np[:n]
+        interesting = np.flatnonzero(
+            eg["became_candidate"][:n]
+            | eg["became_leader"][:n]
+            | eg["term_or_vote_changed"][:n]
+            | (eg["commit_advanced_to"][:n] > applied)
+            | eg["needs_host"][:n]
+        )
+        for i in set(consumed) | set(interesting.tolist()):
+            g = self.groups[i]
+            if g is None:
+                continue
+            g.role = int(eg["role"][i])
+            g.term = int(eg["term"][i])
+            g.leader_slot = int(eg["leader_slot"][i])
+            if eg["term_or_vote_changed"][i] and self.meta is not None:
+                self.meta.store_sync(f"{g.cluster_name}_{g.name}", "current_term", g.term)
+            if eg["became_candidate"][i]:
+                self._hot.add(i)  # keep stepping (single-member self-election)
+                self._broadcast_vote_req(g, queue_send, pre=False)
+            if eg["became_leader"][i]:
+                self._on_became_leader(g, aer_dirty)
+            ci = int(eg["commit_advanced_to"][i])
+            if ci > g.last_applied:
+                self._apply_group(g, ci)
+                aer_dirty.add(i)
+            if eg["needs_host"][i] and g.host_term_hint is None:
+                # quorum term lookup outside the device window (the AER
+                # branch may already have claimed the hint slot; that one
+                # retries first and the quorum resolves next step)
+                agreed = int(eg["agreed_idx"][i])
+                t = g.log.fetch_term(agreed)
+                if t is not None:
+                    g.host_term_hint = (agreed, t)
+                    self._hot.add(i)
+
+        for node_name, msgs in outbound.items():
+            self._send_batch(node_name, msgs)
+
+    def _build_reply(self, g: GroupHost, msg, eg, i):
+        if isinstance(msg, AppendEntriesRpc):
+            return AppendEntriesReply(
+                term=int(eg["term"][i]),
+                success=bool(eg["success"][i]),
+                next_index=int(eg["next_index"][i]),
+                last_index=int(eg["last_index"][i]),
+                last_term=int(eg["last_term"][i]),
+            )
+        if isinstance(msg, RequestVoteRpc):
+            return RequestVoteResult(int(eg["term"][i]), bool(eg["success"][i]))
+        if isinstance(msg, PreVoteRpc):
+            return PreVoteResult(int(eg["term"][i]), msg.token, bool(eg["success"][i]))
+        return None
+
+    def _host_resolve_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, queue_send):
+        """Deep backfill: resolve the prev term from the host log and
+        re-enqueue with an override (or reject directly when absent)."""
+        t = g.log.fetch_term(msg.prev_log_index)
+        if t is None:
+            li, lt = g.log.last_index_term()
+            snap = g.log.snapshot_index_term()
+            from ra_tpu.ops import decisions as dec
+
+            nid = dec.aer_failure_next_index(
+                g.last_applied, li, msg.prev_log_index, snap[0] if snap else 0
+            )
+            queue_send(
+                from_sid,
+                AppendEntriesReply(g.term, False, nid, li, lt),
+                (g.name, self.name),
+            )
+            return
+        g.host_term_hint = (msg.prev_log_index, t)
+        g.inbox.appendleft((from_sid, msg))  # retry next step with override
+        self._hot.add(g.gid)
+
+    def _host_write_entries(self, g: GroupHost, msg: AppendEntriesRpc) -> None:
+        if not msg.entries:
+            return
+        li, _ = g.log.last_index_term()
+        to_write = []
+        for e in msg.entries:
+            if e.index <= li and g.log.fetch_term(e.index) == e.term:
+                continue
+            to_write = [x for x in msg.entries if x.index >= e.index]
+            break
+        if not to_write and msg.entries[-1].index > li:
+            to_write = [e for e in msg.entries if e.index > li]
+        if to_write:
+            g.log.write(list(to_write))
+            # reconcile the device term ring exactly (clears the
+            # multi-entry unknown interval next step)
+            for e in to_write:
+                self._pending_scatters.append(("a", g.gid, e.index, e.term))
+            wi, _ = g.log.last_written()
+            if wi >= to_write[-1].index:
+                self._pending_scatters.append(("w", g.gid, wi, 0))
+
+    def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, queue_send):
+        """Success ack with the host's durable watermark; deferred until
+        the WAL confirms when the write is still in flight."""
+        last_entry = msg.entries[-1].index if msg.entries else msg.prev_log_index
+        wi, wt = g.log.last_written()
+        if wi >= last_entry:
+            queue_send(
+                from_sid,
+                AppendEntriesReply(term, True, wi + 1, wi, wt),
+                (g.name, self.name),
+            )
+        else:
+            g.pending_ack = (from_sid, last_entry)
+
+    def _on_became_leader(self, g: GroupHost, aer_dirty) -> None:
+        li, _ = g.log.last_index_term()
+        g.next_index = [li + 1] * len(g.members)
+        g.commit_sent = [0] * len(g.members)
+        g.leader_slot = g.self_slot
+        leaderboard.record(g.cluster_name, (g.name, self.name), tuple(g.members))
+        # the new term's noop (commit gate + version carrier)
+        idx = g.log.next_index()
+        g.log.append(Entry(index=idx, term=g.term, cmd=Command(kind=NOOP)))
+        self._pending_scatters.append(("a", g.gid, idx, g.term))
+        wi, _ = g.log.last_written()
+        if wi >= idx:
+            self._pending_scatters.append(("w", g.gid, wi, 0))
+        aer_dirty.add(g.gid)
+
+    def _apply_group(self, g: GroupHost, commit_index: int) -> None:
+        li, _ = g.log.last_index_term()
+        hi = min(commit_index, li)
+
+        def apply_one(entry: Entry, acc):
+            cmd = entry.cmd
+            if isinstance(cmd, Command) and cmd.kind == USR:
+                meta = {"index": entry.index, "term": entry.term,
+                        "machine_version": g.effective_machine_version}
+                state, reply, _effs = normalize_apply_result(
+                    g.machine.apply(meta, cmd.data, g.machine_state)
+                )
+                g.machine_state = state
+                fut = g.pending_replies.pop(entry.index, None)
+                if fut is not None and g.role == C.R_LEADER:
+                    self._reply(fut, ("ok", reply, (g.name, self.name)))
+            return acc
+
+        if hi > g.last_applied:
+            g.log.fold(g.last_applied + 1, hi, apply_one, None)
+            g.last_applied = hi
+            self._applied_np[g.gid] = hi
+
+    # -- outbound ----------------------------------------------------------
+
+    def _reply(self, fut, value) -> None:
+        setter = getattr(fut, "set_result", None)
+        if setter is not None:
+            setter(value)
+        elif callable(fut):
+            fut(value)
+
+    def _send_batch(self, node_name: str, msgs) -> None:
+        node = self.registry.get(node_name)
+        if node is None:
+            return
+        if isinstance(node, BatchCoordinator) and node is not self:
+            # one hop for the whole batch; honor the same fault-injection
+            # and liveness rules as InProcTransport.send
+            if not node.running or (self.name, node_name) in self.transport.blocked:
+                self.transport.dropped += len(msgs)
+                return
+            drop = self.transport.drop_fn
+            with node._ingress_cv:
+                for to, msg, frm in msgs:
+                    if drop is not None and drop(to, msg):
+                        self.transport.dropped += 1
+                        continue
+                    node._ingress.append((to[0], frm, msg))
+                node._ingress_cv.notify()
+            return
+        for to, msg, frm in msgs:
+            self.transport.send(to, msg, from_sid=frm)
+
+    def _broadcast_vote_req(self, g: GroupHost, queue_send, pre: bool) -> None:
+        li, lt = g.log.last_index_term()
+        sid = (g.name, self.name)
+        if pre:
+            rpc = PreVoteRpc(
+                term=g.term, token=0, candidate_id=sid, version=1,
+                machine_version=g.machine.version(), last_log_index=li,
+                last_log_term=lt,
+            )
+        else:
+            rpc = RequestVoteRpc(
+                term=g.term, candidate_id=sid, last_log_index=li, last_log_term=lt
+            )
+        for s, member in enumerate(g.members):
+            if s != g.self_slot:
+                queue_send(member, rpc, sid)
+
+    def _send_aers(self, aer_dirty) -> None:
+        outbound: Dict[str, List] = {}
+        for gid in aer_dirty:
+            g = self.groups[gid]
+            if g is None or g.role != C.R_LEADER:
+                continue
+            li, _ = g.log.last_index_term()
+            commit = g.last_applied  # host mirror of commit (applied == committed here)
+            sid = (g.name, self.name)
+            for s, member in enumerate(g.members):
+                if s == g.self_slot:
+                    continue
+                nxt = g.next_index[s]
+                entries: List[Entry] = []
+                if nxt <= li:
+                    hi = min(li, nxt + self.aer_batch_size - 1)
+                    for idx in range(nxt, hi + 1):
+                        e = g.log.fetch(idx)
+                        if e is None:
+                            break
+                        entries.append(e)
+                elif commit <= g.commit_sent[s]:
+                    continue  # nothing new to say
+                prev_idx = nxt - 1
+                prev_term = g.log.fetch_term(prev_idx)
+                if prev_term is None:
+                    continue  # snapshot catch-up not supported in batch mode
+                rpc = AppendEntriesRpc(
+                    term=g.term, leader_id=sid, prev_log_index=prev_idx,
+                    prev_log_term=prev_term, leader_commit=commit,
+                    entries=tuple(entries),
+                )
+                outbound.setdefault(member[1], []).append((member, rpc, sid))
+                if entries:
+                    g.next_index[s] = entries[-1].index + 1
+                g.commit_sent[s] = commit
+        for node_name, msgs in outbound.items():
+            self._send_batch(node_name, msgs)
+
+    # -- rare paths --------------------------------------------------------
+
+    def _handle_rare(self, g: GroupHost, msg, from_sid) -> None:
+        if isinstance(msg, ElectionTimeout):
+            if g.role == C.R_LEADER:
+                return
+            # start pre-vote host-side: scatter the role, broadcast the rpc
+            self.state = C.set_roles(
+                self.state,
+                jnp.asarray([g.gid], jnp.int32),
+                jnp.asarray([C.R_PRE_VOTE], jnp.int32),
+            )
+            g.role = C.R_PRE_VOTE
+            self._hot.add(g.gid)  # force steps so the election progresses
+            if len(g.members) == 1:
+                return  # the next device steps self-elect
+            outbound: Dict[str, List] = {}
+
+            def queue_send(to, m, frm):
+                outbound.setdefault(to[1], []).append((to, m, frm))
+
+            self._broadcast_vote_req(g, queue_send, pre=True)
+            for node_name, msgs in outbound.items():
+                self._send_batch(node_name, msgs)
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "local_query":
+            _, fn, fut = msg
+            self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "state_query":
+            _, fn, fut = msg
+            self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
+            return
+
+    # -- failure detection -------------------------------------------------
+
+    def _detect_loop(self) -> None:
+        while self.running:
+            try:
+                # a stopped node unregisters: include previously-seen
+                # names so disappearance reads as death
+                known = set(self.registry.names()) | set(self._node_status)
+                for other in known:
+                    if other == self.name:
+                        continue
+                    alive = self.transport.node_alive(other)
+                    prev = self._node_status.get(other)
+                    self._node_status[other] = alive
+                    if prev is True and not alive:
+                        self._on_node_down(other)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(self._detector_poll_s)
+
+    def _on_node_down(self, node_name: str) -> None:
+        for i in range(self.n_groups):
+            g = self.groups[i]
+            if g is None or g.role == C.R_LEADER:
+                continue
+            leader = g.sid_of(g.leader_slot)
+            if leader is not None and leader[1] == node_name:
+                delay = self.election_timeout_s * (1 + random.random())
+                threading.Timer(
+                    delay, lambda gg=g: self.deliver((gg.name, self.name), ElectionTimeout(), None)
+                ).start()
+
+    def overview(self) -> dict:
+        return {
+            "node": self.name,
+            "backend": "tpu_batch",
+            "groups": self.n_groups,
+            "steps": self.steps,
+            "msgs": self.msgs_processed,
+        }

@@ -20,6 +20,7 @@ class TimerService:
     def __init__(self) -> None:
         self._heap: list = []
         self._cancelled: set = set()
+        self._live: set = set()
         self._cv = threading.Condition()
         self._closed = False
         self._refs = itertools.count(1)
@@ -30,6 +31,7 @@ class TimerService:
         ref = next(self._refs)
         with self._cv:
             heapq.heappush(self._heap, (time.monotonic() + delay_s, ref, cb))
+            self._live.add(ref)
             self._cv.notify()
         return ref
 
@@ -37,7 +39,10 @@ class TimerService:
         if ref is None:
             return
         with self._cv:
-            self._cancelled.add(ref)
+            # only pending timers can be cancelled; marking fired refs
+            # would leak them in the set forever
+            if ref in self._live:
+                self._cancelled.add(ref)
 
     def _run(self) -> None:
         while True:
@@ -52,9 +57,13 @@ class TimerService:
                     self._cv.wait(timeout=min(deadline - now, 0.5))
                     continue
                 heapq.heappop(self._heap)
+                self._live.discard(ref)
                 if ref in self._cancelled:
                     self._cancelled.discard(ref)
                     continue
+            # NOTE: a cancel() arriving after this point cannot stop the
+            # callback; consumers treat late fires as spurious (e.g. an
+            # ElectionTimeout with a live leader aborts harmlessly)
             try:
                 cb()
             except Exception:  # noqa: BLE001

@@ -849,7 +849,9 @@ class Server:
     ) -> None:
         mode = cmd.reply_mode
         if mode == "await_consensus":
-            from_ref = cmd.from_ref or self.pending_replies.pop(entry.index, None)
+            # pop unconditionally: the table must not leak one future per
+            # command on the normal in-memory-entry path
+            from_ref = self.pending_replies.pop(entry.index, None) or cmd.from_ref
             if from_ref is not None:
                 effects.append(Reply(from_ref, ("ok", reply, self.id)))
         elif isinstance(mode, tuple) and mode and mode[0] == "notify":

@@ -1,0 +1,235 @@
+"""Batch-coordinator tests: device-stepped multi-group consensus.
+
+The tpu_batch backend: groups live as device-array rows; one fused step
+serves all of them. Covers single-node many-group operation, replicated
+multi-coordinator clusters, interop with the actor backend, and failover.
+Runs on the forced-CPU JAX platform from conftest.
+"""
+
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.protocol import Command, ElectionTimeout, USR
+from ra_tpu.runtime.coordinator import BatchCoordinator
+from ra_tpu.runtime.transport import registry
+from ra_tpu.ops import consensus as C
+
+
+def adder():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def await_(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+@pytest.fixture
+def coord():
+    leaderboard.clear()
+    c = BatchCoordinator("bc1", capacity=64, num_peers=3)
+    c.start()
+    yield c
+    c.stop()
+    leaderboard.clear()
+
+
+def test_single_member_groups_elect_and_apply(coord):
+    G = 16
+    for g in range(G):
+        sid = (f"g{g}", "bc1")
+        coord.add_group(f"g{g}", f"cl{g}", [sid], adder())
+        coord.deliver(sid, ElectionTimeout(), None)
+    await_(lambda: all(coord.by_name[f"g{g}"].role == C.R_LEADER for g in range(G)),
+           what="all groups leader")
+    futs = []
+    for g in range(G):
+        fut = api.Future()
+        coord.deliver((f"g{g}", "bc1"),
+                      Command(kind=USR, data=g + 1, reply_mode="await_consensus",
+                              from_ref=fut), None)
+        futs.append(fut)
+    for g, fut in enumerate(futs):
+        out = fut.result(5)
+        assert out[0] == "ok" and out[1] == g + 1, out
+    assert coord.msgs_processed >= 0
+    assert coord.steps > 0
+
+
+def test_replicated_groups_across_three_coordinators():
+    leaderboard.clear()
+    coords = [BatchCoordinator(f"bc{i}", capacity=64, num_peers=3) for i in range(3)]
+    for c in coords:
+        c.start()
+    try:
+        G = 8
+        members = lambda g: [(f"r{g}", f"bc{i}") for i in range(3)]  # noqa: E731
+        for g in range(G):
+            for i, c in enumerate(coords):
+                c.add_group(f"r{g}", f"rc{g}", members(g), adder())
+        for g in range(G):
+            coords[0].deliver((f"r{g}", "bc0"), ElectionTimeout(), None)
+        await_(lambda: all(coords[0].by_name[f"r{g}"].role == C.R_LEADER
+                           for g in range(G)), what="bc0 leads all groups")
+        # commands replicate and commit across coordinators
+        futs = []
+        for g in range(G):
+            fut = api.Future()
+            coords[0].deliver((f"r{g}", "bc0"),
+                              Command(kind=USR, data=10 + g,
+                                      reply_mode="await_consensus", from_ref=fut),
+                              None)
+            futs.append(fut)
+        for g, fut in enumerate(futs):
+            out = fut.result(5)
+            assert out[0] == "ok" and out[1] == 10 + g
+        # followers applied too
+        await_(lambda: all(
+            coords[1].by_name[f"r{g}"].machine_state == 10 + g for g in range(G)
+        ), what="follower state convergence")
+        await_(lambda: all(
+            coords[2].by_name[f"r{g}"].machine_state == 10 + g for g in range(G)
+        ), what="follower state convergence 2")
+    finally:
+        for c in coords:
+            c.stop()
+        leaderboard.clear()
+
+
+def test_batch_group_interops_with_actor_backend(tmp_path):
+    """One member on the batch coordinator, two on actor nodes — the two
+    backends speak the same protocol."""
+    from ra_tpu.system import SystemConfig
+
+    leaderboard.clear()
+    coord = BatchCoordinator("bx", capacity=64, num_peers=3)
+    coord.start()
+    nodes = []
+    for n in ("ax1", "ax2"):
+        cfg = SystemConfig(name="iop", data_dir=str(tmp_path))
+        nodes.append(api.start_node(n, cfg, election_timeout_s=0.1,
+                                    tick_interval_s=0.1, detector_poll_s=0.05))
+    try:
+        ids = [("m1", "bx"), ("m2", "ax1"), ("m3", "ax2")]
+        coord.add_group("m1", "iopc", ids, adder())
+        for sid in ids[1:]:
+            api.start_server(sid, "iopc", adder(), ids)
+        # elect the batch-backed member
+        coord.deliver(("m1", "bx"), ElectionTimeout(), None)
+        await_(lambda: coord.by_name["m1"].role == C.R_LEADER, what="batch leader")
+        fut = api.Future()
+        coord.deliver(("m1", "bx"),
+                      Command(kind=USR, data=42, reply_mode="await_consensus",
+                              from_ref=fut), None)
+        out = fut.result(5)
+        assert out[0] == "ok" and out[1] == 42
+        # actor-backed followers applied it
+        await_(lambda: api.local_query(("m2", "ax1"), lambda s: s)[1] == 42,
+               what="actor follower applied")
+        await_(lambda: api.local_query(("m3", "ax2"), lambda s: s)[1] == 42,
+               what="actor follower 2 applied")
+        # and an actor-backed member can take over leadership
+        api.trigger_election(("m2", "ax1"))
+        await_(lambda: leaderboard.lookup_leader("iopc") == ("m2", "ax1"),
+               what="actor takes over")
+        r, _ = api.process_command(("m2", "ax1"), 8)
+        assert r == 50
+        await_(lambda: coord.by_name["m1"].machine_state == 50,
+               what="batch member follows actor leader")
+    finally:
+        coord.stop()
+        for n in ("ax1", "ax2"):
+            api.stop_node(n)
+        leaderboard.clear()
+
+
+def test_coordinator_failover():
+    leaderboard.clear()
+    coords = {i: BatchCoordinator(f"fc{i}", capacity=64, num_peers=3,
+                                  election_timeout_s=0.1, detector_poll_s=0.05)
+              for i in range(3)}
+    for c in coords.values():
+        c.start()
+    try:
+        ids = [(f"f1", f"fc{i}") for i in range(3)]
+        for i, c in coords.items():
+            c.add_group("f1", "fgrp", ids, adder())
+        coords[0].deliver(("f1", "fc0"), ElectionTimeout(), None)
+        await_(lambda: coords[0].by_name["f1"].role == C.R_LEADER, what="fc0 leads")
+        fut = api.Future()
+        coords[0].deliver(("f1", "fc0"),
+                          Command(kind=USR, data=5, reply_mode="await_consensus",
+                                  from_ref=fut), None)
+        assert fut.result(5)[1] == 5
+        # kill the leader coordinator
+        coords[0].stop()
+        await_(lambda: any(coords[i].by_name["f1"].role == C.R_LEADER
+                           for i in (1, 2)), timeout=20, what="batch failover")
+        new_leader = next(i for i in (1, 2)
+                          if coords[i].by_name["f1"].role == C.R_LEADER)
+        fut2 = api.Future()
+        coords[new_leader].deliver((f"f1", f"fc{new_leader}"),
+                                   Command(kind=USR, data=7,
+                                           reply_mode="await_consensus",
+                                           from_ref=fut2), None)
+        out = fut2.result(5)
+        assert out[0] == "ok" and out[1] == 12  # state survived
+    finally:
+        for i in (1, 2):
+            coords[i].stop()
+        leaderboard.clear()
+
+
+def test_commit_with_one_dead_replica():
+    """Quorum (2/3) must keep committing after a replica coordinator
+    dies — regression for the stale-watermark ack deadlock."""
+    leaderboard.clear()
+    coords = {i: BatchCoordinator(f"dc{i}", capacity=64, num_peers=3)
+              for i in range(3)}
+    for c in coords.values():
+        c.start()
+    try:
+        ids = [("d1", f"dc{i}") for i in range(3)]
+        for c in coords.values():
+            c.add_group("d1", "dgrp", ids, adder())
+        coords[0].deliver(("d1", "dc0"), ElectionTimeout(), None)
+        await_(lambda: coords[0].by_name["d1"].role == C.R_LEADER, what="dc0 leads")
+        fut = api.Future()
+        coords[0].deliver(("d1", "dc0"),
+                          Command(kind=USR, data=4, reply_mode="await_consensus",
+                                  from_ref=fut), None)
+        assert fut.result(10)[1] == 4
+        coords[2].stop()
+        fut2 = api.Future()
+        coords[0].deliver(("d1", "dc0"),
+                          Command(kind=USR, data=6, reply_mode="await_consensus",
+                                  from_ref=fut2), None)
+        out = fut2.result(10)
+        assert out[0] == "ok" and out[1] == 10
+    finally:
+        for i in (0, 1):
+            coords[i].stop()
+        leaderboard.clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _warm_kernel():
+    """Pre-compile the fused step for the shared (64, 3) shape so
+    per-test waits measure the runtime, not XLA compile time."""
+    c = BatchCoordinator("warmup", capacity=64, num_peers=3)
+    try:
+        sid = ("w0", "warmup")
+        c.add_group("w0", "wcl", [sid], adder())
+        c.deliver(sid, ElectionTimeout(), None)
+        for _ in range(3):
+            c.step_once()
+    finally:
+        c.registry.unregister("warmup")
