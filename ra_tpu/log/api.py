@@ -94,13 +94,18 @@ class LogApi:
         raise NotImplementedError
 
     def update_release_cursor(
-        self, idx: int, cluster, machine_version: int, machine_state: Any
+        self, idx: int, cluster, machine_version: int, machine_state: Any,
+        live_indexes=(),
     ) -> List[Any]:
         """Machine says state <= idx is captured in machine_state: maybe
-        take a snapshot and truncate."""
+        take a snapshot and truncate everything below except
+        ``live_indexes`` (log-as-value-store retention)."""
         raise NotImplementedError
 
-    def checkpoint(self, idx: int, cluster, machine_version: int, machine_state: Any) -> List[Any]:
+    def checkpoint(
+        self, idx: int, cluster, machine_version: int, machine_state: Any,
+        live_indexes=(),
+    ) -> List[Any]:
         raise NotImplementedError
 
     def promote_checkpoint(self, idx: int) -> List[Any]:

@@ -238,15 +238,24 @@ class Log(LogApi):
         self.segs.truncate_below(meta.index, live)
 
     def update_release_cursor(
-        self, idx: int, cluster, machine_version: int, machine_state: Any
+        self, idx: int, cluster, machine_version: int, machine_state: Any,
+        live_indexes=(),
     ) -> List[Any]:
         cur = self._snapshot_meta.index if self._snapshot_meta else 0
         if idx <= cur or (idx - cur) < self.min_snapshot_interval:
             return []
-        return self._take_snapshot(idx, cluster, machine_version, machine_state)
+        return self._take_snapshot(
+            idx, cluster, machine_version, machine_state,
+            live_indexes=tuple(i for i in live_indexes if i <= idx),
+        )
 
-    def force_snapshot(self, idx, cluster, machine_version, machine_state) -> List[Any]:
-        return self._take_snapshot(idx, cluster, machine_version, machine_state)
+    def force_snapshot(
+        self, idx, cluster, machine_version, machine_state, live_indexes=()
+    ) -> List[Any]:
+        return self._take_snapshot(
+            idx, cluster, machine_version, machine_state,
+            live_indexes=tuple(i for i in live_indexes if i <= idx),
+        )
 
     def _take_snapshot(self, idx, cluster, machine_version, machine_state,
                        live_indexes: Tuple[int, ...] = ()) -> List[Any]:
@@ -264,14 +273,20 @@ class Log(LogApi):
         self._post_snapshot(meta)
         return []
 
-    def checkpoint(self, idx, cluster, machine_version, machine_state) -> List[Any]:
+    def checkpoint(
+        self, idx, cluster, machine_version, machine_state, live_indexes=()
+    ) -> List[Any]:
         if (idx - self._last_checkpoint_idx) < self.min_checkpoint_interval:
             return []
         t = self.fetch_term(idx)
         if t is None:
             return []
+        # live indexes are carried in the checkpoint meta: a later
+        # promotion installs it as a snapshot and must retain them
         meta = SnapshotMeta(
-            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version
+            index=idx, term=t, cluster=tuple(cluster),
+            machine_version=machine_version,
+            live_indexes=tuple(i for i in live_indexes if i <= idx),
         )
         self.snapshots.write(meta, machine_state, kind=CHECKPOINT)
         self._last_checkpoint_idx = idx

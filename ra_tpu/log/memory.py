@@ -187,23 +187,29 @@ class MemoryLog(LogApi):
         self._pending = self._pending.floor(meta.index + 1)
         return []
 
-    def update_release_cursor(self, idx, cluster, machine_version, machine_state) -> List[Any]:
+    def update_release_cursor(
+        self, idx, cluster, machine_version, machine_state, live_indexes=()
+    ) -> List[Any]:
         if idx <= (self._snapshot[0].index if self._snapshot else 0):
             return []
         t = self.fetch_term(idx)
         if t is None:
             return []
         meta = SnapshotMeta(
-            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version
+            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version,
+            live_indexes=tuple(i for i in live_indexes if i <= idx),
         )
         return self.install_snapshot(meta, machine_state)
 
-    def checkpoint(self, idx, cluster, machine_version, machine_state) -> List[Any]:
+    def checkpoint(
+        self, idx, cluster, machine_version, machine_state, live_indexes=()
+    ) -> List[Any]:
         t = self.fetch_term(idx)
         if t is None:
             return []
         meta = SnapshotMeta(
-            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version
+            index=idx, term=t, cluster=tuple(cluster), machine_version=machine_version,
+            live_indexes=tuple(i for i in live_indexes if i <= idx),
         )
         self._checkpoints.append((meta, machine_state))
         return []

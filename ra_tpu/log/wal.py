@@ -64,6 +64,7 @@ class Wal:
         compute_checksums: bool = True,
         threaded: bool = True,
         counter=None,
+        native: bool = True,
     ):
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
@@ -74,6 +75,14 @@ class Wal:
         self.max_batch_size = max_batch_size
         self.sync_method = sync_method
         self.compute_checksums = compute_checksums
+        # resolve (and if needed g++-build) the native framer NOW, off the
+        # commit path — a lazy first-batch build would stall every queued
+        # append behind a compiler run
+        if native:
+            from ra_tpu import native as _native
+
+            native = _native.available()
+        self._native = native
         self.counter = counter or ra_counters.Counters("wal", ra_counters.WAL_FIELDS)
 
         self._lock = threading.Lock()
@@ -172,14 +181,16 @@ class Wal:
         return batch
 
     def _write_batch(self, batch: List[Tuple]) -> None:
-        buf = bytearray()
+        # first pass: bookkeeping + record collection; second: framing
+        # (natively when ra_tpu.native built) + one write/fsync
+        records: List[Tuple[int, int, int, int, bytes]] = []
         # (uid, term) -> indexes written in this batch
         written: Dict[Tuple[str, int], List[int]] = {}
         resends: List[Tuple[str, int]] = []
         for kind, uid, idx, term, payload in batch:
             if kind == "t":
-                ref = self._uid_ref(uid, buf)
-                buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
+                ref = self._uid_ref(uid, records)
+                records.append((K_TRUNC, ref, idx, 0, b""))
                 self._last_idx[uid] = idx - 1
                 self._file_seqs[uid] = self._file_seqs.get(uid, Seq.empty()).limit(idx - 1)
                 continue
@@ -201,14 +212,8 @@ class Wal:
                     self.counter.incr("out_of_seq")
                     resends.append((uid, max(last, snap_idx) + 1))
                     continue
-            ref = self._uid_ref(uid, buf)
-            crc = (
-                zlib.crc32(struct.pack("<QQ", idx, term) + payload)
-                if self.compute_checksums
-                else 0
-            )
-            buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc, len(payload))
-            buf += payload
+            ref = self._uid_ref(uid, records)
+            records.append((K_ENTRY, ref, idx, term, payload))
             seq = self._file_seqs.get(uid, Seq.empty())
             if kind == "s":
                 # sparse writes never imply truncation of higher indexes
@@ -221,7 +226,8 @@ class Wal:
                 self._file_seqs[uid] = seq.add(idx)
             written.setdefault((uid, term), []).append(idx)
 
-        if buf:
+        if records:
+            buf = self._frame(records)
             self._file.write(buf)
             self._sync()
             self.counter.incr("batches")
@@ -245,15 +251,41 @@ class Wal:
             os.fsync(self._file.fileno())
             self.counter.incr("fsyncs")
 
-    def _uid_ref(self, uid: str, buf: bytearray) -> int:
+    def _uid_ref(self, uid: str, records: List[Tuple]) -> int:
         ref = self._uid_refs.get(uid)
         if ref is None:
             ref = len(self._uid_refs) + 1
             self._uid_refs[uid] = ref
             ub = uid.encode()
-            buf += _UID_HDR.pack(K_UID, ref, len(ub))
-            buf += ub
+            records.append((K_UID, ref, len(ub), 0, ub))
         return ref
+
+    def _frame(self, records: List[Tuple[int, int, int, int, bytes]]) -> bytes:
+        """Frame records for the file — native C++ when available
+        (ra_tpu.native.wal_native), byte-identical Python fallback."""
+        if self._native:
+            from ra_tpu import native
+
+            out = native.frame_batch(records, compute_crc=self.compute_checksums)
+            if out is not None:
+                return out
+            self._native = False  # build failed: stay on the fallback
+        buf = bytearray()
+        for kind, ref, idx, term, payload in records:
+            if kind == K_UID:
+                buf += _UID_HDR.pack(K_UID, ref, len(payload))
+                buf += payload
+            elif kind == K_TRUNC:
+                buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
+            else:
+                crc = (
+                    zlib.crc32(struct.pack("<QQ", idx, term) + payload)
+                    if self.compute_checksums
+                    else 0
+                )
+                buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc, len(payload))
+                buf += payload
+        return bytes(buf)
 
     # ------------------------------------------------------------------
     # rollover & recovery

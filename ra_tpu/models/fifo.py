@@ -1,0 +1,128 @@
+"""FIFO queue machine — the quorum-queue-precursor workload.
+
+Capability model: the reference's ``test/ra_fifo.erl`` (a full FIFO queue
+machine used by its nemesis/partition suites): checkout-based consumers,
+per-consumer in-flight settlement, monitor-driven consumer cleanup,
+release-cursor emission once everything settled.
+
+Commands:
+  ("enqueue", msg)
+  ("checkout", consumer_id)          -- register a consumer (prefetch 1)
+  ("settle", consumer_id, msg_id)
+  ("return", consumer_id, msg_id)    -- redeliver
+  ("cancel", consumer_id)
+  ("down", consumer_id, info)        -- builtin monitor DOWN
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict, deque
+from typing import Any, Dict, Optional, Tuple
+
+from ra_tpu.effects import Monitor, ReleaseCursor, SendMsg
+from ra_tpu.machine import Machine
+
+
+@dataclasses.dataclass
+class FifoState:
+    queue: deque = dataclasses.field(default_factory=deque)  # (msg_id, msg)
+    next_msg_id: int = 1
+    # consumer_id -> {msg_id: msg} in-flight
+    consumers: "OrderedDict[Any, Dict[int, Any]]" = dataclasses.field(
+        default_factory=OrderedDict
+    )
+    service_queue: deque = dataclasses.field(default_factory=deque)  # ready consumers
+    low_settled_index: int = 0
+
+    def clone(self) -> "FifoState":
+        st = FifoState(
+            queue=deque(self.queue),
+            next_msg_id=self.next_msg_id,
+            consumers=OrderedDict((k, dict(v)) for k, v in self.consumers.items()),
+            service_queue=deque(self.service_queue),
+            low_settled_index=self.low_settled_index,
+        )
+        return st
+
+
+class FifoMachine(Machine):
+    def init(self, config) -> FifoState:
+        return FifoState()
+
+    def apply(self, meta, cmd, state: FifoState):
+        if not isinstance(cmd, tuple) or not cmd:
+            return state, None
+        st = state.clone()
+        op = cmd[0]
+        effects = []
+        if op == "enqueue":
+            msg_id = st.next_msg_id
+            st.next_msg_id += 1
+            st.queue.append((msg_id, cmd[1]))
+            self._service(st, effects)
+            return st, ("ok", msg_id), effects
+        if op == "checkout":
+            cid = cmd[1]
+            if cid not in st.consumers:
+                st.consumers[cid] = {}
+                effects.append(Monitor("process", cid, "machine"))
+            if cid not in st.service_queue:
+                st.service_queue.append(cid)
+            self._service(st, effects)
+            return st, ("ok", None), effects
+        if op == "settle":
+            _, cid, msg_id = cmd
+            inflight = st.consumers.get(cid, {})
+            inflight.pop(msg_id, None)
+            if cid in st.consumers and cid not in st.service_queue:
+                st.service_queue.append(cid)
+            self._service(st, effects)
+            if not st.queue and all(not f for f in st.consumers.values()):
+                effects.append(ReleaseCursor(meta["index"], st))
+            return st, ("ok", None), effects
+        if op == "return":
+            _, cid, msg_id = cmd
+            inflight = st.consumers.get(cid, {})
+            msg = inflight.pop(msg_id, None)
+            if msg is not None:
+                st.queue.appendleft((msg_id, msg))
+            self._service(st, effects)
+            return st, ("ok", None), effects
+        if op in ("cancel", "down"):
+            cid = cmd[1]
+            inflight = st.consumers.pop(cid, None)
+            if cid in st.service_queue:
+                st.service_queue.remove(cid)
+            if inflight:
+                for msg_id, msg in sorted(inflight.items()):
+                    st.queue.appendleft((msg_id, msg))
+                self._service(st, effects)
+            return st, ("ok", None), effects
+        return state, ("error", "unknown_op")
+
+    def _service(self, st: FifoState, effects) -> None:
+        """Deliver queued messages to ready consumers (prefetch 1)."""
+        while st.queue and st.service_queue:
+            cid = st.service_queue[0]
+            inflight = st.consumers.get(cid)
+            if inflight is None:
+                st.service_queue.popleft()
+                continue
+            if inflight:
+                st.service_queue.popleft()
+                continue  # busy (prefetch 1)
+            msg_id, msg = st.queue.popleft()
+            inflight[msg_id] = msg
+            st.service_queue.popleft()
+            effects.append(
+                SendMsg(cid, ("delivery", msg_id, msg), ("ra_event",))
+            )
+
+    def overview(self, state: FifoState):
+        return {
+            "type": "fifo",
+            "ready": len(state.queue),
+            "consumers": len(state.consumers),
+            "in_flight": sum(len(f) for f in state.consumers.values()),
+        }

@@ -1,0 +1,136 @@
+"""Native (C++) acceleration for the storage hot paths.
+
+Builds ``wal_native.cpp`` with g++ on first import (cached ``.so`` next
+to the source) and exposes ctypes bindings. Everything here has a pure-
+Python fallback — ``available()`` reports whether the native path is in
+use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "wal_native.cpp")
+_SO = os.path.join(_HERE, "wal_native.so")
+
+_lib = None
+_lock = threading.Lock()
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _SO
+    except Exception:
+        return None
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.wal_frame_batch.restype = ctypes.c_long
+        lib.wal_frame_batch.argtypes = [
+            ctypes.c_char_p,  # kinds u8*
+            ctypes.c_void_p,  # refs u16*
+            ctypes.c_void_p,  # idxs u64*
+            ctypes.c_void_p,  # terms u64*
+            ctypes.c_void_p,  # offs u64*
+            ctypes.c_void_p,  # lens u32*
+            ctypes.c_long,
+            ctypes.c_char_p,  # blob
+            ctypes.c_int,
+            ctypes.c_void_p,  # out
+            ctypes.c_long,
+        ]
+        lib.wal_frame_bound.restype = ctypes.c_long
+        lib.wal_frame_bound.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long]
+        lib.wal_crc32.restype = ctypes.c_uint32
+        lib.wal_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# record: (kind:int, ref:int, idx:int, term:int, payload:bytes)
+Record = Tuple[int, int, int, int, bytes]
+
+
+def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[bytes]:
+    """Frame a WAL batch natively; None when the native lib is absent."""
+    lib = _load()
+    if lib is None or not records:
+        return None if lib is None else b""
+    n = len(records)
+    kinds = np.empty(n, np.uint8)
+    refs = np.empty(n, np.uint16)
+    idxs = np.empty(n, np.uint64)
+    terms = np.empty(n, np.uint64)
+    offs = np.empty(n, np.uint64)
+    lens = np.empty(n, np.uint32)
+    parts = []
+    off = 0
+    for i, (kind, ref, idx, term, payload) in enumerate(records):
+        kinds[i] = kind
+        refs[i] = ref
+        idxs[i] = idx
+        terms[i] = term
+        offs[i] = off
+        lens[i] = len(payload)
+        parts.append(payload)
+        off += len(payload)
+    blob = b"".join(parts)
+    bound = lib.wal_frame_bound(
+        kinds.ctypes.data_as(ctypes.c_char_p), lens.ctypes.data, n
+    )
+    out = ctypes.create_string_buffer(bound)
+    w = lib.wal_frame_batch(
+        kinds.ctypes.data_as(ctypes.c_char_p),
+        refs.ctypes.data,
+        idxs.ctypes.data,
+        terms.ctypes.data,
+        offs.ctypes.data,
+        lens.ctypes.data,
+        n,
+        blob,
+        1 if compute_crc else 0,
+        ctypes.cast(out, ctypes.c_void_p),
+        bound,
+    )
+    if w < 0:
+        return None
+    return out.raw[:w]
+
+
+def crc32(data: bytes) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.wal_crc32(data, len(data)))

@@ -1,0 +1,129 @@
+// Native WAL batch framing.
+//
+// The shared WAL's hot loop frames every queued record (header pack +
+// CRC32 over idx|term|payload) before one write+fdatasync per batch.
+// This library does the framing for a whole batch in one call: Python
+// hands down parallel arrays (kinds, refs, idx, term, payload offsets)
+// plus one concatenated payload blob, and gets back the framed bytes.
+//
+// Record wire format (little-endian, must match ra_tpu/log/wal.py):
+//   uid-def : kind=1 | ref u16 | len u16 | uid bytes
+//   entry   : kind=2 | ref u16 | idx u64 | term u64 | crc u32 | len u32
+//             | payload
+//   trunc   : kind=3 | ref u16 | idx u64
+//
+// Build: g++ -O2 -shared -fPIC -o wal_native.so wal_native.cpp
+// (no external deps; CRC32 implemented here, polynomial 0xEDB88320,
+// matching zlib.crc32).
+
+#include <cstdint>
+#include <cstring>
+
+static uint32_t crc_table[256];
+static bool crc_ready = false;
+
+static void crc_init() {
+    for (uint32_t n = 0; n < 256; n++) {
+        uint32_t c = n;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[n] = c;
+    }
+    crc_ready = true;
+}
+
+static uint32_t crc32_update(uint32_t crc, const uint8_t* buf, uint64_t len) {
+    crc = crc ^ 0xFFFFFFFFu;
+    for (uint64_t i = 0; i < len; i++)
+        crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+extern "C" {
+
+// Returns the number of bytes written into `out` (caller sizes it via
+// wal_frame_bound), or -1 if out_cap would be exceeded.
+//
+// kinds[i]: 1=uid-def, 2=entry, 3=trunc
+// refs[i]:  writer ref
+// idxs[i], terms[i]: entry/trunc fields (uid-def: idx = uid byte length)
+// offs[i]..offs[i]+lens[i]: payload slice in `blob` (entry payload or
+//   uid bytes for uid-def; empty for trunc)
+// compute_crc: 0 disables checksums (crc field written as 0)
+long wal_frame_batch(
+    const uint8_t* kinds,
+    const uint16_t* refs,
+    const uint64_t* idxs,
+    const uint64_t* terms,
+    const uint64_t* offs,
+    const uint32_t* lens,
+    long n,
+    const uint8_t* blob,
+    int compute_crc,
+    uint8_t* out,
+    long out_cap
+) {
+    if (!crc_ready) crc_init();
+    long w = 0;
+    for (long i = 0; i < n; i++) {
+        uint8_t kind = kinds[i];
+        if (kind == 1) {  // uid-def: B H H + uid bytes
+            uint32_t ln = lens[i];
+            if (w + 5 + (long)ln > out_cap) return -1;
+            out[w++] = 1;
+            memcpy(out + w, &refs[i], 2); w += 2;
+            uint16_t l16 = (uint16_t)ln;
+            memcpy(out + w, &l16, 2); w += 2;
+            memcpy(out + w, blob + offs[i], ln); w += ln;
+        } else if (kind == 2) {  // entry: B H Q Q I I + payload
+            uint32_t ln = lens[i];
+            if (w + 27 + (long)ln > out_cap) return -1;
+            out[w++] = 2;
+            memcpy(out + w, &refs[i], 2); w += 2;
+            memcpy(out + w, &idxs[i], 8); w += 8;
+            memcpy(out + w, &terms[i], 8); w += 8;
+            uint32_t crc = 0;
+            if (compute_crc) {
+                uint8_t hdr[16];
+                memcpy(hdr, &idxs[i], 8);
+                memcpy(hdr + 8, &terms[i], 8);
+                crc = crc32_update(0, hdr, 16);
+                // zlib-style incremental: crc32(payload, crc32(hdr))
+                crc = crc ^ 0xFFFFFFFFu;
+                const uint8_t* p = blob + offs[i];
+                for (uint32_t b = 0; b < ln; b++)
+                    crc = crc_table[(crc ^ p[b]) & 0xFF] ^ (crc >> 8);
+                crc = crc ^ 0xFFFFFFFFu;
+            }
+            memcpy(out + w, &crc, 4); w += 4;
+            memcpy(out + w, &ln, 4); w += 4;
+            memcpy(out + w, blob + offs[i], ln); w += ln;
+        } else if (kind == 3) {  // trunc: B H Q
+            if (w + 11 > out_cap) return -1;
+            out[w++] = 3;
+            memcpy(out + w, &refs[i], 2); w += 2;
+            memcpy(out + w, &idxs[i], 8); w += 8;
+        } else {
+            return -1;
+        }
+    }
+    return w;
+}
+
+// Exact upper bound for the framed size of a batch.
+long wal_frame_bound(const uint8_t* kinds, const uint32_t* lens, long n) {
+    long total = 0;
+    for (long i = 0; i < n; i++) {
+        if (kinds[i] == 1) total += 5 + lens[i];
+        else if (kinds[i] == 2) total += 27 + lens[i];
+        else total += 11;
+    }
+    return total;
+}
+
+uint32_t wal_crc32(const uint8_t* buf, uint64_t len) {
+    if (!crc_ready) crc_init();
+    return crc32_update(0, buf, len);
+}
+
+}  // extern "C"

@@ -820,19 +820,23 @@ class Server:
         out: List[Effect] = []
         for eff in mac_effects:
             if isinstance(eff, ReleaseCursor):
+                mac = self.machine.which_module(self.effective_machine_version)
                 self.log.update_release_cursor(
                     eff.index,
                     tuple(self.members()),
                     self.effective_machine_version,
                     eff.machine_state,
+                    live_indexes=tuple(mac.live_indexes(eff.machine_state)),
                 )
                 self._c("releases")
             elif isinstance(eff, Checkpoint):
+                mac = self.machine.which_module(self.effective_machine_version)
                 self.log.checkpoint(
                     eff.index,
                     tuple(self.members()),
                     self.effective_machine_version,
                     eff.machine_state,
+                    live_indexes=tuple(mac.live_indexes(eff.machine_state)),
                 )
                 self._c("checkpoints_written")
             else:

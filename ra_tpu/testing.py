@@ -1,0 +1,68 @@
+"""Scripted fault-injection (nemesis) harness.
+
+Capability parity with the reference's ``test/nemesis.erl`` scenario
+runner (``{part, Nodes, Ms} | {wait, Ms} | {app_restart, Servers} |
+heal`` — test/nemesis.erl:29-33, over inet_tcp_proxy): here the faults
+drive the in-proc transport's partition hooks, so the same scripts work
+against actor nodes and batch coordinators.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Sequence, Tuple
+
+from ra_tpu.runtime.transport import registry as node_registry
+
+
+def _block_pair(a: str, b: str) -> None:
+    na, nb = node_registry().get(a), node_registry().get(b)
+    if na is not None:
+        na.transport.block(a, b)
+    if nb is not None:
+        nb.transport.block(b, a)
+
+
+def heal_all() -> None:
+    for name in node_registry().names():
+        node = node_registry().get(name)
+        if node is not None:
+            node.transport.unblock_all()
+
+
+def partition(minority: Sequence[str], rest: Sequence[str]) -> None:
+    for a in minority:
+        for b in rest:
+            _block_pair(a, b)
+
+
+def run_scenario(script: List[Tuple], api_mod=None) -> None:
+    """Execute a nemesis script. Steps:
+
+    ("part", [nodes...], [other nodes...], seconds) — partition then heal
+    ("part_hold", [nodes...], [other nodes...])     — partition, no heal
+    ("wait", seconds)
+    ("restart", [server_ids...])                    — restart server procs
+    ("heal",)
+    """
+    for step in script:
+        op = step[0]
+        if op == "part":
+            _, minority, rest, secs = step
+            partition(minority, rest)
+            time.sleep(secs)
+            heal_all()
+        elif op == "part_hold":
+            _, minority, rest = step
+            partition(minority, rest)
+        elif op == "wait":
+            time.sleep(step[1])
+        elif op == "restart":
+            from ra_tpu import api as _api
+
+            for sid in step[1]:
+                (api_mod or _api).restart_server(sid)
+        elif op == "heal":
+            heal_all()
+        else:
+            raise ValueError(f"unknown nemesis step {step!r}")

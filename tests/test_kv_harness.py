@@ -1,0 +1,91 @@
+"""Randomized KV consistency harness.
+
+Capability model: the reference's ``ra_kv_harness`` (``src/ra_kv_harness
+.erl`` — random put/get/delete/restart/partition ops against a KV
+cluster with a reference map, consistency-failure detection). Bounded
+for CI: a few hundred ops with faults, then full convergence checking."""
+
+import random
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard, testing
+from ra_tpu.models.kv import KvMachine, kv_get
+from ra_tpu.system import SystemConfig
+
+NODES = ("hA", "hB", "hC")
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_randomized_kv_consistency(tmp_path, seed):
+    rng = random.Random(seed)
+    leaderboard.clear()
+    for n in NODES:
+        cfg = SystemConfig(name=f"kvh{seed}", data_dir=str(tmp_path))
+        cfg.min_snapshot_interval = 16
+        api.start_node(n, cfg, election_timeout_s=0.1, tick_interval_s=0.1,
+                       detector_poll_s=0.05)
+    ids = [(f"h{i}", NODES[i]) for i in range(3)]
+    try:
+        api.start_cluster("kvh", lambda: KvMachine(snapshot_interval=16), ids)
+        reference = {}
+        keys = [f"key{i}" for i in range(8)]
+        partitioned = None
+        for step in range(120):
+            op = rng.random()
+            target = rng.choice(
+                [sid for sid in ids if sid[1] != partitioned] or ids
+            )
+            try:
+                if op < 0.55:
+                    k, v = rng.choice(keys), rng.randint(0, 10 ** 6)
+                    r, _ = api.process_command(target, ("put", k, v), timeout=10,
+                                               retry_on_timeout=True)
+                    if r[0] == "ok":
+                        reference[k] = v
+                elif op < 0.7:
+                    k = rng.choice(keys)
+                    r, _ = api.process_command(target, ("delete", k), timeout=10,
+                                               retry_on_timeout=True)
+                    if r[0] == "ok":
+                        reference.pop(k, None)
+                elif op < 0.9:
+                    k = rng.choice(keys)
+                    leader = leaderboard.lookup_leader("kvh")
+                    if leader and (partitioned is None or leader[1] != partitioned):
+                        got = kv_get(api, leader, k, timeout=10)
+                        assert got == reference.get(k), (
+                            f"step {step}: {k} = {got!r}, want {reference.get(k)!r}"
+                        )
+                elif op < 0.95 and partitioned is None:
+                    partitioned = rng.choice(NODES)
+                    testing.partition([partitioned],
+                                      [n for n in NODES if n != partitioned])
+                else:
+                    if partitioned is not None:
+                        testing.heal_all()
+                        partitioned = None
+            except api.RaError:
+                continue  # timeouts under faults are expected; retry later
+        testing.heal_all()
+        # convergence: every key matches the reference on the leader
+        deadline = time.monotonic() + 10
+        leader = api.wait_for_leader("kvh", timeout=10)
+        for k in keys:
+            while time.monotonic() < deadline:
+                try:
+                    if kv_get(api, leader, k, timeout=5) == reference.get(k):
+                        break
+                except api.RaError:
+                    pass
+                time.sleep(0.05)
+            assert kv_get(api, leader, k, timeout=5) == reference.get(k), k
+    finally:
+        testing.heal_all()
+        for n in NODES:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()

@@ -1,0 +1,175 @@
+"""Machine-family tests: KV (log-as-value-store), FIFO queue, bench
+machine + driver, offline replay debugger."""
+
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.models.bench_machine import BenchMachine, run_driver
+from ra_tpu.models.fifo import FifoMachine, FifoState
+from ra_tpu.models.kv import KvMachine, kv_get
+from ra_tpu.system import SystemConfig
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    leaderboard.clear()
+    for n in ("mA", "mB", "mC"):
+        cfg = SystemConfig(name="mdl", data_dir=str(tmp_path))
+        cfg.min_snapshot_interval = 8
+        api.start_node(n, cfg, election_timeout_s=0.1, tick_interval_s=0.1,
+                       detector_poll_s=0.05)
+    yield [("x1", "mA"), ("x2", "mB"), ("x3", "mC")]
+    for n in ("mA", "mB", "mC"):
+        try:
+            api.stop_node(n)
+        except Exception:
+            pass
+    leaderboard.clear()
+
+
+# ---------------------------------------------------------------------------
+# KV
+
+
+def test_kv_put_get_delete(cluster3):
+    ids = cluster3
+    api.start_cluster("kv", lambda: KvMachine(snapshot_interval=8), ids)
+    r, leader = api.process_command(ids[0], ("put", "a", {"v": 1}))
+    assert r[0] == "ok"
+    api.process_command(ids[0], ("put", "b", "second"))
+    assert kv_get(api, leader, "a") == {"v": 1}
+    assert kv_get(api, leader, "b") == "second"
+    assert kv_get(api, leader, "missing") is None
+    r, _ = api.process_command(ids[0], ("delete", "a"))
+    assert r[0] == "ok"
+    assert kv_get(api, leader, "a") is None
+    keys, _ = api.process_command(ids[0], ("keys",))
+    assert keys == ["b"]
+
+
+def test_kv_values_survive_compaction(cluster3):
+    """The machine state holds only indexes; after snapshotting, live
+    log entries must still serve reads (live_indexes retention)."""
+    ids = cluster3
+    api.start_cluster("kvc", lambda: KvMachine(snapshot_interval=8), ids)
+    leader = api.wait_for_leader("kvc")
+    # "old" is written once, early: its log entry ends up far below the
+    # snapshot index and must survive as a live index
+    api.process_command(ids[0], ("put", "old", "ancient-value"))
+    for i in range(30):
+        api.process_command(ids[0], ("put", f"k{i % 3}", f"v{i}"))
+    from ra_tpu.runtime.transport import registry
+    srv = registry().get(leader[1]).procs[leader[0]].server
+    snap = srv.log.snapshot_index_term()
+    assert snap is not None
+    old_idx = srv.machine_state["old"][0]
+    assert old_idx < snap[0], "test setup: old value must sit below the snapshot"
+    assert kv_get(api, leader, "old") == "ancient-value"
+    for k in range(3):
+        got = kv_get(api, leader, f"k{k}")
+        assert got is not None and got.startswith("v")
+
+
+# ---------------------------------------------------------------------------
+# FIFO
+
+
+def test_fifo_basic_flow():
+    m = FifoMachine()
+    st = m.init({})
+    meta = lambda i: {"index": i, "term": 1, "machine_version": 0}  # noqa: E731
+    st, r, effs = m.apply(meta(1), ("enqueue", "hello"), st)
+    assert r == ("ok", 1)
+    st, r, effs = m.apply(meta(2), ("checkout", "c1"), st)
+    deliveries = [e for e in effs if getattr(e, "msg", None) and e.msg[0] == "delivery"]
+    assert deliveries and deliveries[0].msg == ("delivery", 1, "hello")
+    # prefetch 1: second enqueue not delivered until settle
+    st, r, effs = m.apply(meta(3), ("enqueue", "world"), st)
+    assert not [e for e in effs if getattr(e, "msg", None)]
+    st, r, effs = m.apply(meta(4), ("settle", "c1", 1), st)
+    deliveries = [e for e in effs if getattr(e, "msg", None) and e.msg[0] == "delivery"]
+    assert deliveries and deliveries[0].msg[2] == "world"
+
+
+def test_fifo_down_redelivers_inflight():
+    m = FifoMachine()
+    st = m.init({})
+    meta = lambda i: {"index": i, "term": 1, "machine_version": 0}  # noqa: E731
+    st, _, _ = m.apply(meta(1), ("enqueue", "m1"), st)
+    st, _, effs = m.apply(meta(2), ("checkout", "c1"), st)
+    assert any(getattr(e, "msg", None) == ("delivery", 1, "m1") for e in effs)
+    # consumer dies with m1 in flight; another consumer picks it up
+    st, _, _ = m.apply(meta(3), ("down", "c1", "crash"), st)
+    st, _, effs = m.apply(meta(4), ("checkout", "c2"), st)
+    assert any(getattr(e, "msg", None) == ("delivery", 1, "m1") for e in effs)
+
+
+def test_fifo_release_cursor_when_drained():
+    from ra_tpu.effects import ReleaseCursor
+
+    m = FifoMachine()
+    st = m.init({})
+    meta = lambda i: {"index": i, "term": 1, "machine_version": 0}  # noqa: E731
+    st, _, _ = m.apply(meta(1), ("enqueue", "m1"), st)
+    st, _, _ = m.apply(meta(2), ("checkout", "c1"), st)
+    st, _, effs = m.apply(meta(3), ("settle", "c1", 1), st)
+    assert any(isinstance(e, ReleaseCursor) for e in effs)
+
+
+def test_fifo_through_cluster(cluster3):
+    ids = cluster3
+    api.start_cluster("q1", FifoMachine, ids)
+    deliveries = []
+    leader = api.wait_for_leader("q1")
+    api.register_client(leader[1], "consumer-1", lambda _f, msgs: deliveries.extend(msgs))
+    api.process_command(ids[0], ("enqueue", "job-1"))
+    api.process_command(ids[0], ("checkout", "consumer-1"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not deliveries:
+        time.sleep(0.02)
+    assert deliveries and deliveries[0] == ("delivery", 1, "job-1")
+    r, _ = api.process_command(ids[0], ("settle", "consumer-1", 1))
+    assert r == ("ok", None)
+
+
+# ---------------------------------------------------------------------------
+# bench machine + driver
+
+
+def test_bench_driver_smoke(cluster3):
+    ids = cluster3
+    api.start_cluster("bm", BenchMachine, ids)
+    leader = api.wait_for_leader("bm")
+    ops_per_sec, completed = run_driver(
+        api, leader, "bench-client", leader[1],
+        target_ops=200, degree=2, pipe_size=50,
+    )
+    assert completed == 200
+    assert ops_per_sec > 0
+
+
+# ---------------------------------------------------------------------------
+# offline replay
+
+
+def test_dbg_replay_log(tmp_path, cluster3):
+    from ra_tpu.dbg import replay_log
+
+    ids = cluster3
+    api.start_cluster("rp", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    for i in range(5):
+        api.process_command(ids[0], i + 1)
+    api.stop_node("mA")
+    # replay node mA's copy offline
+    node_dir = str(tmp_path / "mA")
+    uid = "rp_x1"
+    seen = []
+    state, applied = replay_log(
+        node_dir, uid, SimpleMachine(lambda c, s: s + c, 0),
+        on_entry=lambda i, cmd, st: seen.append((i, cmd)),
+    )
+    assert state == 15
+    assert len(seen) == 5

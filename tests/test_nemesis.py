@@ -1,0 +1,173 @@
+"""Scripted-fault (nemesis) and property-based convergence tests.
+
+Capability model: the reference's partitions_SUITE (enqueue/drain under
+partitions via inet_tcp_proxy scripts) and ra_props_SUITE (random
+non-associative command sequences must fold identically on every
+replica — replicated-log determinism)."""
+
+import random
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard, testing
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.models.fifo import FifoMachine
+from ra_tpu.system import SystemConfig
+
+from harness import three_node_net
+
+NS1, NS2, NS3 = ("s1", "nodeA"), ("s2", "nodeB"), ("s3", "nodeC")
+
+
+NODES = ("pA", "pB", "pC")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    leaderboard.clear()
+    for n in NODES:
+        cfg = SystemConfig(name="nem", data_dir=str(tmp_path))
+        api.start_node(n, cfg, election_timeout_s=0.1, tick_interval_s=0.1,
+                       detector_poll_s=0.05)
+    ids = [("n1", "pA"), ("n2", "pB"), ("n3", "pC")]
+    yield ids
+    testing.heal_all()
+    for n in NODES:
+        try:
+            api.stop_node(n)
+        except Exception:
+            pass
+    leaderboard.clear()
+
+
+def converged(ids, expect, timeout=8):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            vals = [api.local_query(sid, lambda s: s)[1] for sid in ids]
+            if all(v == expect for v in vals):
+                return True
+        except api.RaError:
+            pass
+        time.sleep(0.05)
+    return False
+
+
+def test_commands_survive_rolling_partitions(cluster):
+    ids = cluster
+    api.start_cluster("nemc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    total = 0
+    committed = 0
+    for round_no in range(3):
+        # partition a different node away each round
+        odd = NODES[round_no % 3]
+        rest = [n for n in NODES if n != odd]
+        testing.run_scenario([("part_hold", [odd], rest)])
+        # majority side keeps accepting writes
+        target = next(sid for sid in ids if sid[1] != odd)
+        for k in range(5):
+            r, _ = api.process_command(target, 1, timeout=10, retry_on_timeout=True)
+            committed += 1
+            total += 1
+        testing.heal_all()
+    assert converged(ids, committed), "replicas diverged after partitions"
+
+
+def test_fifo_enqueue_drain_under_partition(cluster):
+    """partitions_SUITE shape: enqueue through faults, then drain and
+    check every committed message comes out exactly once, in order."""
+    ids = cluster
+    api.start_cluster("nq", FifoMachine, ids)
+    enq = []
+    for i in range(10):
+        if i == 4:
+            testing.run_scenario([("part_hold", [NODES[0]], list(NODES[1:]))])
+        if i == 7:
+            testing.heal_all()
+        target = next(sid for sid in ids if sid[1] != NODES[0]) if 4 <= i < 7 else ids[0]
+        r, _ = api.process_command(target, ("enqueue", f"m{i}"),
+                                   timeout=10, retry_on_timeout=True)
+        assert r[0] == "ok"
+        enq.append(f"m{i}")
+    testing.heal_all()
+    # drain
+    leader = api.wait_for_leader("nq")
+    deliveries = []
+    api.register_client(leader[1], "drainer", lambda _f, m: deliveries.extend(m))
+    api.process_command(ids[0], ("checkout", "drainer"), retry_on_timeout=True)
+    got = []
+    deadline = time.monotonic() + 15
+    while len(got) < len(enq) and time.monotonic() < deadline:
+        while deliveries:
+            _, msg_id, payload = deliveries.pop(0)
+            got.append(payload)
+            api.process_command(ids[0], ("settle", "drainer", msg_id),
+                                retry_on_timeout=True)
+        time.sleep(0.02)
+    assert got == enq, f"drained {got}, enqueued {enq}"
+
+
+def test_leader_minority_cannot_commit_during_partition(cluster):
+    ids = cluster
+    api.start_cluster("mnc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    leader = api.wait_for_leader("mnc")
+    api.process_command(ids[0], 1)
+    lnode = leader[1]
+    rest = [n for n in NODES if n != lnode]
+    testing.run_scenario([("part_hold", [lnode], rest)])
+    # a command addressed to the isolated (stale) leader must not succeed
+    with pytest.raises(api.RaError):
+        api.process_command(leader, 100, timeout=1.5)
+    testing.heal_all()
+    # and after heal, it never appears anywhere... unless the retry path
+    # reconciles — the stale append gets overwritten by the new leader
+    assert converged(ids, 1)
+
+
+# ---------------------------------------------------------------------------
+# property: replicated-log determinism with non-associative ops
+
+
+def _fold(ops, acc=1):
+    for op, n in ops:
+        if op == "add":
+            acc = acc + n
+        elif op == "mul":
+            acc = acc * n
+        elif op == "sub":
+            acc = n - acc  # deliberately order-sensitive
+    return acc
+
+
+class _OpMachine(SimpleMachine):
+    def __init__(self):
+        super().__init__(lambda cmd, s: _fold([cmd], s), 1)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_random_op_sequences_converge(seed):
+    """Every replica's folded state equals the reference fold of the
+    committed command sequence (ra_props_SUITE property) — driven through
+    the deterministic in-test Net for speed."""
+    rng = random.Random(seed)
+    net = three_node_net(_OpMachine)
+    net.elect(NS1)
+    ops = []
+    for _ in range(60):
+        op = rng.choice(["add", "mul", "sub"])
+        n = rng.randint(-5, 7)
+        ops.append((op, n))
+        net.command(NS1, (op, n))
+        if rng.random() < 0.1:
+            # transient partition of a random follower
+            victim = rng.choice([NS2, NS3])
+            net.partition(NS1, victim)
+            net.command(NS1, ("add", 0))
+            ops.append(("add", 0))
+            net.heal()
+            net.command(NS1, ("add", 0))
+            ops.append(("add", 0))
+    expect = _fold(ops)
+    for sid in (NS1, NS2, NS3):
+        assert net.servers[sid].machine_state == expect, sid
