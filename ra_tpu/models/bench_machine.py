@@ -50,7 +50,8 @@ def run_driver(
     done = threading.Event()
     completed = [0]
     lock = threading.Lock()
-    total = target_ops
+    # each client sends total // degree; round so completion is reachable
+    total = (target_ops // degree) * degree
 
     def sink(_from, corrs):
         with lock:

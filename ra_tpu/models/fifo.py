@@ -87,6 +87,10 @@ class FifoMachine(Machine):
             msg = inflight.pop(msg_id, None)
             if msg is not None:
                 st.queue.appendleft((msg_id, msg))
+            # the returning consumer is ready again (else the returned
+            # message sits undelivered until an unrelated op services it)
+            if cid in st.consumers and cid not in st.service_queue:
+                st.service_queue.append(cid)
             self._service(st, effects)
             return st, ("ok", None), effects
         if op in ("cancel", "down"):

@@ -68,20 +68,23 @@ class KvMachine(Machine):
 
 def kv_get(api_mod, member, key, timeout: float = 5.0) -> Optional[Any]:
     """Read a value: consistent-query the index map, then fetch the
-    value from the log (the reference reads via aux/read plans;
-    here the state query returns the index and the log read follows)."""
-    out = api_mod.consistent_query(member, lambda st: st.get(key), timeout=timeout)
-    if out[0] != "ok" or out[1] is None:
-        return None
-    idx, digest = out[1]
-    entry = _fetch_log_entry(api_mod, member, idx, timeout)
-    if entry is None:
-        return None
-    cmd = entry.cmd
-    value = cmd.data[2]
-    if _digest(value) != digest:
-        raise IOError(f"kv digest mismatch for {key!r} at idx {idx}")
-    return value
+    value from the log (the reference reads via aux/read plans; here the
+    state query returns the index and the log read follows). Retries the
+    state query when the fetch misses — a concurrent overwrite + snapshot
+    may compact the index read in the first round trip."""
+    for _attempt in range(3):
+        out = api_mod.consistent_query(member, lambda st: st.get(key), timeout=timeout)
+        if out[0] != "ok" or out[1] is None:
+            return None
+        idx, digest = out[1]
+        entry = _fetch_log_entry(api_mod, member, idx, timeout)
+        if entry is None:
+            continue  # compacted under us: re-resolve the current index
+        value = entry.cmd.data[2]
+        if _digest(value) != digest:
+            raise IOError(f"kv digest mismatch for {key!r} at idx {idx}")
+        return value
+    return None
 
 
 def _fetch_log_entry(api_mod, member, idx, timeout):

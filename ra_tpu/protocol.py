@@ -52,6 +52,40 @@ class Command:
 # -- snapshot metadata -----------------------------------------------------
 
 
+def strip_entry_refs(entries: "Tuple[Entry, ...]") -> "Tuple[Entry, ...]":
+    """Drop process-ephemeral reply handles from entries about to cross a
+    process boundary (replication / snapshot pre-chunks). The leader
+    keeps the handles in its pending-reply table; remote copies never
+    need them."""
+    out = []
+    changed = False
+    for e in entries:
+        cmd = e.cmd
+        if isinstance(cmd, Command) and cmd.from_ref is not None:
+            out.append(
+                Entry(e.index, e.term, dataclasses.replace(cmd, from_ref=None))
+            )
+            changed = True
+        else:
+            out.append(e)
+    return tuple(out) if changed else entries
+
+
+def sanitize_for_wire(msg: Any) -> Any:
+    """Make a protocol message safe to serialize across processes."""
+    if isinstance(msg, AppendEntriesRpc) and msg.entries:
+        stripped = strip_entry_refs(msg.entries)
+        if stripped is not msg.entries:
+            return dataclasses.replace(msg, entries=stripped)
+    if isinstance(msg, InstallSnapshotRpc) and msg.chunk_phase == CHUNK_PRE:
+        data = msg.data
+        if isinstance(data, (list, tuple)):
+            return dataclasses.replace(
+                msg, data=list(strip_entry_refs(tuple(data)))
+            )
+    return msg
+
+
 def encode_cmd(cmd: Any) -> bytes:
     """Serialize a log command for durable storage. Client reply handles
     (``from_ref``) are process-ephemeral — replies are never re-issued

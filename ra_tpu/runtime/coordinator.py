@@ -763,6 +763,7 @@ class BatchCoordinator:
     # -- failure detection -------------------------------------------------
 
     def _detect_loop(self) -> None:
+        cooldown: Dict[int, float] = {}
         while self.running:
             try:
                 # a stopped node unregisters: include previously-seen
@@ -776,6 +777,22 @@ class BatchCoordinator:
                     self._node_status[other] = alive
                     if prev is True and not alive:
                         self._on_node_down(other)
+                # suspicion sweep (transitions can be missed): followers
+                # with a dead leader node retry elections on a cooldown
+                now = time.monotonic()
+                for i in range(self.n_groups):
+                    g = self.groups[i]
+                    if g is None or g.role == C.R_LEADER:
+                        continue
+                    leader = g.sid_of(g.leader_slot)
+                    if (
+                        leader is not None
+                        and leader[1] != self.name
+                        and not self.transport.node_alive(leader[1])
+                        and now - cooldown.get(i, 0.0) > 3 * self.election_timeout_s
+                    ):
+                        cooldown[i] = now + random.random() * self.election_timeout_s
+                        self.deliver((g.name, self.name), ElectionTimeout(), None)
             except Exception:  # noqa: BLE001
                 pass
             time.sleep(self._detector_poll_s)

@@ -67,6 +67,7 @@ class RaNode:
         election_timeout_s: float = 0.15,
         detector_poll_s: float = 0.1,
         scheduler_workers: int = 4,
+        tcp: bool = False,
     ):
         self.name = name
         self.config = config or SystemConfig(name="default")
@@ -106,9 +107,20 @@ class RaNode:
         )
         self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
         self.directory = Directory(self.meta)
-        self.transport = InProcTransport(name, nodes or node_registry())
+        self._registry = nodes or node_registry()
+        if tcp:
+            # real sockets: name must be "host:port"; peers are remote
+            # processes (reference analog: Erlang distribution carriers)
+            from ra_tpu.runtime.tcp import TcpTransport
+
+            self.transport = TcpTransport(name, self.deliver)
+            self.transport.on_proc_down_cb = self.on_proc_down
+        else:
+            self.transport = InProcTransport(name, self._registry)
         self.running = True
-        (nodes or node_registry()).register(name, self)
+        # the local registry serves in-process clients (api module) even
+        # for TCP nodes
+        self._registry.register(name, self)
 
         self._node_status: Dict[str, bool] = {}
         self._detector_poll_s = detector_poll_s
@@ -197,11 +209,18 @@ class RaNode:
             # (the reference's erlang monitors on the leader,
             # follower_leader_change src/ra_server_proc.erl:1958)
             sid = proc.server.id
-            for other in list(self.transport.nodes.nodes.values()):
+            reg = getattr(self.transport, "nodes", None)
+            others = list(reg.nodes.values()) if reg is not None else [self]
+            for other in others:
                 try:
                     other.on_proc_down(sid)
                 except Exception:  # noqa: BLE001
                     pass
+            # over TCP, announce to remote peers explicitly (the wire
+            # stand-in for remote process monitors)
+            broadcast = getattr(self.transport, "broadcast_proc_down", None)
+            if broadcast is not None:
+                broadcast(sid)
 
     def delete_server(self, name: str) -> None:
         uid = self.directory.uid_of(name)
@@ -303,6 +322,26 @@ class RaNode:
                         status = "up" if alive else "down"
                         for proc in list(self.procs.values()):
                             proc.on_node_event(other, status)
+                # suspicion sweep: transitions can be missed (a leader
+                # that dies before its node was ever recorded alive) —
+                # a follower with a dead leader node and stale contact
+                # arms its election timer regardless
+                from ra_tpu.server import AWAIT_CONDITION, FOLLOWER
+
+                now = _t.monotonic()
+                for proc in list(self.procs.values()):
+                    srv = proc.server
+                    leader = srv.leader_id
+                    if (
+                        srv.role in (FOLLOWER, AWAIT_CONDITION)
+                        and leader is not None
+                        and leader != srv.id
+                        and srv.is_voter_self()
+                        and proc._election_ref is None
+                        and not self.transport.node_alive(leader[1])
+                        and now - proc.last_leader_contact > 2 * self.election_timeout_s
+                    ):
+                        proc.arm_election_timer()
             except Exception:  # noqa: BLE001
                 pass
             _t.sleep(self._detector_poll_s)
@@ -347,4 +386,7 @@ class RaNode:
         self.scheduler.close()
         self.timers.close()
         self.bg.shutdown(wait=False)
-        self.transport.nodes.unregister(self.name)
+        closer = getattr(self.transport, "close", None)
+        if closer is not None:
+            closer()
+        self._registry.unregister(self.name)

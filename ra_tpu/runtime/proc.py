@@ -180,6 +180,7 @@ class ServerProc:
         self.snapshot_ack_timeout_s = 120.0
         self._election_ref: Optional[int] = None
         self._tick_ref: Optional[int] = None
+        self.last_leader_contact: float = time.monotonic()
         self._senders: Dict[ServerId, SnapshotSender] = {}
         self._machine_timers: Dict[Any, int] = {}
         self.running = True
@@ -254,9 +255,11 @@ class ServerProc:
         stale in-flight message from an already-dead sender is NOT
         liveness evidence — without this check a dead leader's last AERs
         can cancel the armed timer and leave the cluster leaderless."""
+        if not isinstance(msg.msg, (AppendEntriesRpc, InstallSnapshotRpc, HeartbeatRpc)):
+            return
+        self.last_leader_contact = time.monotonic()
         if (
-            isinstance(msg.msg, (AppendEntriesRpc, InstallSnapshotRpc, HeartbeatRpc))
-            and self.server.role in (FOLLOWER, AWAIT_CONDITION, RECEIVE_SNAPSHOT)
+            self.server.role in (FOLLOWER, AWAIT_CONDITION, RECEIVE_SNAPSHOT)
             and self._election_ref is not None
             and self.transport.proc_alive(msg.peer)
         ):

@@ -107,6 +107,19 @@ def test_fifo_down_redelivers_inflight():
     assert any(getattr(e, "msg", None) == ("delivery", 1, "m1") for e in effs)
 
 
+def test_fifo_return_redelivers_immediately():
+    """Regression: a returned message must be redelivered to the (now
+    ready again) consumer without waiting for an unrelated op."""
+    m = FifoMachine()
+    st = m.init({})
+    meta = lambda i: {"index": i, "term": 1, "machine_version": 0}  # noqa: E731
+    st, _, _ = m.apply(meta(1), ("enqueue", "hello"), st)
+    st, _, effs = m.apply(meta(2), ("checkout", "c1"), st)
+    assert any(getattr(e, "msg", None) == ("delivery", 1, "hello") for e in effs)
+    st, _, effs = m.apply(meta(3), ("return", "c1", 1), st)
+    assert any(getattr(e, "msg", None) == ("delivery", 1, "hello") for e in effs)
+
+
 def test_fifo_release_cursor_when_drained():
     from ra_tpu.effects import ReleaseCursor
 

@@ -1,0 +1,155 @@
+"""TCP transport tests: consensus over real sockets.
+
+Three RaNodes in this process, each with its own TcpTransport bound to a
+localhost port — every inter-node protocol message crosses a real TCP
+connection (no shared in-proc registry shortcut). Plus a true
+multi-process smoke test.
+"""
+
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.system import SystemConfig
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture
+def tcp_cluster(tmp_path):
+    leaderboard.clear()
+    names = [f"127.0.0.1:{free_port()}" for _ in range(3)]
+    for n in names:
+        cfg = SystemConfig(name="tcp", data_dir=str(tmp_path))
+        api.start_node(n, cfg, election_timeout_s=0.15, tick_interval_s=0.1,
+                       detector_poll_s=0.05, tcp=True)
+    ids = [(f"t{i}", names[i]) for i in range(3)]
+    yield ids, names
+    for n in names:
+        try:
+            api.stop_node(n)
+        except Exception:
+            pass
+    leaderboard.clear()
+
+
+def test_consensus_over_tcp(tcp_cluster):
+    ids, names = tcp_cluster
+    started, failed = api.start_cluster(
+        "tcpc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids, timeout=15
+    )
+    assert failed == []
+    reply, leader = api.process_command(ids[0], 5, timeout=10)
+    assert reply == 5
+    reply, _ = api.process_command(ids[1], 7, timeout=10)
+    assert reply == 12
+    # all replicas converge over sockets
+    deadline = time.monotonic() + 8
+    while time.monotonic() < deadline:
+        vals = [api.local_query(sid, lambda s: s)[1] for sid in ids]
+        if vals == [12, 12, 12]:
+            break
+        time.sleep(0.05)
+    assert vals == [12, 12, 12]
+    assert api.consistent_query(ids[0], lambda s: s, timeout=10)[1] == 12
+
+
+def test_tcp_failover(tcp_cluster):
+    ids, names = tcp_cluster
+    api.start_cluster("tcpf", lambda: SimpleMachine(lambda c, s: s + c, 0),
+                      ids, timeout=15)
+    api.process_command(ids[0], 1, timeout=10)
+    leader = api.wait_for_leader("tcpf")
+    api.stop_node(leader[1])  # whole node down: sockets drop
+    deadline = time.monotonic() + 15
+    new_leader = None
+    while time.monotonic() < deadline:
+        cand = leaderboard.lookup_leader("tcpf")
+        if cand is not None and cand != leader and api._is_running(cand):
+            new_leader = cand
+            break
+        time.sleep(0.05)
+    assert new_leader is not None, "no TCP failover"
+    reply, _ = api.process_command(new_leader, 9, timeout=10)
+    assert reply == 10
+
+
+_WORKER = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from ra_tpu import api
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.system import SystemConfig
+
+me, port, peers, data = sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4]
+name = f"127.0.0.1:{{port}}"
+cfg = SystemConfig(name="mp", data_dir=data)
+api.start_node(name, cfg, election_timeout_s=0.2, tick_interval_s=0.1,
+               detector_poll_s=0.05, tcp=True)
+members = [(f"m{{i}}", p) for i, p in enumerate(peers.split(","))]
+sid = next(s for s in members if s[1] == name)
+api.start_server(sid, "mpc", SimpleMachine(lambda c, s: s + c, 0), members)
+print("READY", flush=True)
+if me == "driver":
+    time.sleep(1.0)  # let peers come up
+    api.trigger_election(sid)
+    api.wait_for_leader("mpc", timeout=15)
+    total = 0
+    for i in range(1, 6):
+        r, _ = api.process_command(sid, i, timeout=15, retry_on_timeout=True)
+        total = r
+    print("RESULT", total, flush=True)
+    time.sleep(0.5)
+else:
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        v = api.local_query(sid, lambda s: s, timeout=5)[1]
+        if v == 15:
+            print("CONVERGED", v, flush=True)
+            break
+        time.sleep(0.1)
+"""
+
+
+def test_multiprocess_cluster(tmp_path):
+    """Three real OS processes, one member each, consensus over TCP."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ports = [free_port() for _ in range(3)]
+    peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+    script = _WORKER.format(repo=repo)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    procs = []
+    try:
+        for i, port in enumerate(ports):
+            role = "driver" if i == 0 else "follower"
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", script, role, str(port), peers,
+                     str(tmp_path / f"p{i}")],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                    env=env,
+                )
+            )
+        out0, err0 = procs[0].communicate(timeout=90)
+        assert "RESULT 15" in out0, (out0, err0)
+        out1, _ = procs[1].communicate(timeout=60)
+        out2, _ = procs[2].communicate(timeout=60)
+        assert "CONVERGED 15" in out1
+        assert "CONVERGED 15" in out2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
