@@ -385,3 +385,10 @@ def aux_command(server_id: ServerId, cmd: Any, timeout: float = 5.0):
 
 def overview(node_name: str) -> dict:
     return _node(node_name).overview()
+
+
+def counters_overview() -> dict:
+    """All registered counters/gauges (reference: ra_counters:overview)."""
+    from ra_tpu import counters as _counters
+
+    return _counters.overview()

@@ -29,6 +29,15 @@ RECOVERY = "recovery"
 _TRAILER = struct.Struct("<I")
 
 
+def decode_snapshot_chunks(chunks) -> Any:
+    """Reassemble a transferred snapshot body. In-proc transfers may ship
+    the machine state as one direct object chunk; remote transfers ship
+    pickled byte chunks. Single wire-format rule for both backends."""
+    if len(chunks) == 1 and not isinstance(chunks[0], (bytes, bytearray)):
+        return chunks[0]
+    return pickle.loads(b"".join(chunks))
+
+
 class SnapshotCodec:
     """Pluggable serialization behaviour (cf. the reference's snapshot
     behaviour callbacks: prepare/write/begin_read/read_chunk/

@@ -531,6 +531,34 @@ def record_written(state: GroupState, group_ids: jax.Array, idxs: jax.Array) -> 
 
 
 @jax.jit
+def record_snapshot(
+    state: GroupState, group_ids: jax.Array, idxs: jax.Array, terms: jax.Array
+) -> GroupState:
+    """Host installed snapshots for the named groups: move the snapshot
+    boundary, advance tails/watermarks/commit, clear ring staleness."""
+    touched = jnp.zeros_like(state.role, dtype=jnp.bool_).at[group_ids].set(True)
+    snap_idx = state.snapshot_index.at[group_ids].set(idxs)
+    snap_term = state.snapshot_term.at[group_ids].set(terms)
+    last_index = state.last_index.at[group_ids].max(idxs)
+    at_snap = last_index == snap_idx
+    last_term = jnp.where(touched & at_snap, snap_term, state.last_term)
+    written = state.written_index.at[group_ids].max(idxs)
+    commit = state.commit_index.at[group_ids].max(idxs)
+    unknown_lo = jnp.where(touched, 1, state.unknown_lo)
+    unknown_hi = jnp.where(touched, 0, state.unknown_hi)
+    return state._replace(
+        snapshot_index=snap_idx,
+        snapshot_term=snap_term,
+        last_index=last_index,
+        last_term=last_term,
+        written_index=written,
+        commit_index=commit,
+        unknown_lo=unknown_lo,
+        unknown_hi=unknown_hi,
+    )
+
+
+@jax.jit
 def set_roles(state: GroupState, group_ids: jax.Array, roles: jax.Array) -> GroupState:
     """Host-driven role transitions (election initiation and similar rare
     paths): scatter new roles and clear election tallies for the named

@@ -55,10 +55,17 @@ from ra_tpu.ops import consensus as C
 from ra_tpu.protocol import (
     AppendEntriesReply,
     AppendEntriesRpc,
+    CHUNK_INIT,
+    CHUNK_LAST,
+    CHUNK_NEXT,
+    CHUNK_PRE,
     Command,
     ElectionTimeout,
     Entry,
     FromPeer,
+    InstallSnapshotAck,
+    InstallSnapshotResult,
+    InstallSnapshotRpc,
     NOOP,
     PreVoteResult,
     PreVoteRpc,
@@ -87,7 +94,7 @@ class GroupHost:
         "machine", "machine_state", "last_applied", "role", "term",
         "leader_slot", "next_index", "commit_sent", "pending_replies",
         "inbox", "host_term_hint", "election_ref", "effective_machine_version",
-        "pending_ack",
+        "pending_ack", "snap_accept", "snap_senders",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -112,6 +119,9 @@ class GroupHost:
         self.election_ref = None
         # deferred AER ack awaiting WAL durability: (leader_sid, up_to_idx)
         self.pending_ack: Optional[Tuple[ServerId, int]] = None
+        # inbound snapshot transfer state / outbound senders per peer
+        self.snap_accept: Optional[Dict[str, Any]] = None
+        self.snap_senders: Dict[ServerId, Any] = {}
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -712,8 +722,11 @@ class BatchCoordinator:
                     continue  # nothing new to say
                 prev_idx = nxt - 1
                 prev_term = g.log.fetch_term(prev_idx)
-                if prev_term is None:
-                    continue  # snapshot catch-up not supported in batch mode
+                snap = g.log.snapshot_index_term()
+                if prev_term is None or (snap is not None and prev_idx < snap[0]):
+                    # peer is behind our compacted floor: stream a snapshot
+                    self._start_snapshot_sender(g, member)
+                    continue
                 rpc = AppendEntriesRpc(
                     term=g.term, leader_id=sid, prev_log_index=prev_idx,
                     prev_log_term=prev_term, leader_commit=commit,
@@ -759,6 +772,137 @@ class BatchCoordinator:
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
             return
+        if isinstance(msg, InstallSnapshotRpc):
+            self._receive_snapshot_chunk(g, msg, from_sid)
+            return
+        if isinstance(msg, (InstallSnapshotAck, InstallSnapshotResult)):
+            sender = g.snap_senders.get(from_sid)
+            if sender is not None:
+                if isinstance(msg, InstallSnapshotAck):
+                    sender.on_ack(msg)
+                else:
+                    sender.on_result(msg)
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "snap_send_done":
+            _, to, result = msg
+            g.snap_senders.pop(to, None)
+            if result is not None and g.role == C.R_LEADER:
+                slot = g.slot_of(to)
+                if slot >= 0:
+                    g.next_index[slot] = max(g.next_index[slot], result.last_index + 1)
+                    # feed the result through the device path for match
+                    g.inbox.append((to, AppendEntriesReply(
+                        result.term, True, result.last_index + 1,
+                        result.last_index, result.last_term)))
+                    self._hot.add(g.gid)
+                    # resume pipelining the post-snapshot tail right away
+                    self._send_aers({g.gid})
+            return
+
+    # -- snapshot transfer (batch-backed groups) ---------------------------
+
+    def _receive_snapshot_chunk(self, g: GroupHost, msg: InstallSnapshotRpc, from_sid):
+        """Host-side 4-phase chunked install; the device learns the new
+        floor via a record_snapshot scatter on completion."""
+        me = (g.name, self.name)
+
+        def send_one(m):
+            self._send_batch(from_sid[1], [(from_sid, m, me)])
+
+        if msg.term < g.term:
+            li, lt = g.log.last_index_term()
+            send_one(InstallSnapshotResult(g.term, li, lt))
+            return
+        if msg.chunk_phase == CHUNK_INIT:
+            # INIT always starts a fresh accumulator — a retried transfer
+            # at the same index must not append onto stale chunks
+            g.snap_accept = {"meta": msg.meta, "chunks": [], "next": 1}
+            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            return
+        acc = g.snap_accept
+        if acc is None or acc["meta"].index != msg.meta.index:
+            return  # no transfer in progress for this snapshot: ignore
+        if msg.chunk_phase == CHUNK_PRE:
+            acc["next"] = max(acc["next"], msg.chunk_no + 1)
+            for e in msg.data:
+                if g.log.fetch_term(e.index) is None:
+                    g.log.write_sparse(e)
+            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            return
+        if msg.chunk_no < acc["next"]:
+            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            return
+        if msg.chunk_no > acc["next"]:
+            return
+        acc["chunks"].append(msg.data)
+        acc["next"] += 1
+        if msg.chunk_phase != CHUNK_LAST:
+            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            return
+        # complete: install host-side, then scatter the floor to device
+        from ra_tpu.log.snapshot import decode_snapshot_chunks
+
+        state_obj = decode_snapshot_chunks(acc["chunks"])
+        meta = acc["meta"]
+        g.log.install_snapshot(meta, state_obj)
+        g.machine_state = state_obj
+        g.effective_machine_version = meta.machine_version
+        g.last_applied = max(g.last_applied, meta.index)
+        self._applied_np[g.gid] = g.last_applied
+        g.term = max(g.term, msg.term)
+        g.leader_slot = g.slot_of(msg.leader_id)
+        g.snap_accept = None
+        gid = jnp.asarray([g.gid], jnp.int32)
+        self.state = C.record_snapshot(
+            self.state, gid, jnp.asarray([meta.index], jnp.int32),
+            jnp.asarray([meta.term], jnp.int32),
+        )
+        self.state = self.state._replace(
+            current_term=self.state.current_term.at[g.gid].max(msg.term),
+            leader_slot=self.state.leader_slot.at[g.gid].set(g.leader_slot),
+            role=self.state.role.at[g.gid].set(C.R_FOLLOWER),
+        )
+        send_one(InstallSnapshotResult(g.term, meta.index, meta.term))
+
+    class _SenderShim:
+        """Adapts a coordinator group to the interface proc.SnapshotSender
+        expects (transport / server.id / enqueue / ack timeout)."""
+
+        def __init__(self, coord: "BatchCoordinator", g: GroupHost):
+            self._coord = coord
+            self._g = g
+            self.transport = coord.transport
+            self.snapshot_ack_timeout_s = 60.0
+            self.server = type(
+                "S", (), {"id": (g.name, coord.name)}
+            )()
+
+        def enqueue(self, msg, front: bool = False):
+            tag = msg[0]
+            to = msg[1]
+            result = msg[2] if tag == "snapshot_send_done" else None
+            self._coord.deliver(
+                (self._g.name, self._coord.name), ("snap_send_done", to, result), None
+            )
+
+    def _start_snapshot_sender(self, g: GroupHost, to: ServerId) -> None:
+        if to in g.snap_senders:
+            return
+        got = g.log.read_snapshot()
+        if got is None:
+            return
+        meta, state_obj = got
+        live_entries = (
+            g.log.sparse_read(list(meta.live_indexes)) if meta.live_indexes else []
+        )
+        from ra_tpu.runtime.proc import SnapshotSender
+
+        sender = SnapshotSender(
+            self._SenderShim(self, g), to, meta, state_obj, live_entries, g.term,
+            1024 * 1024,
+        )
+        g.snap_senders[to] = sender
+        sender.start()
 
     # -- failure detection -------------------------------------------------
 

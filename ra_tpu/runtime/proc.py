@@ -66,14 +66,16 @@ class SnapshotSender:
         proc: "ServerProc",
         to: ServerId,
         meta,
-        chunks: List[bytes],
+        state_obj,
         live_entries: list,
         term: int,
+        chunk_size: int,
     ):
         self.proc = proc
         self.to = to
         self.meta = meta
-        self.chunks = chunks
+        self.state_obj = state_obj
+        self.chunk_size = chunk_size
         self.live_entries = live_entries
         self.term = term
         self.acks: "threading.Condition" = threading.Condition()
@@ -114,6 +116,15 @@ class SnapshotSender:
     def _run(self) -> None:
         proc = self.proc
         try:
+            # serialization happens HERE, off the consensus threads: the
+            # state object was captured immutably by the owning thread
+            import pickle
+
+            blob = pickle.dumps(self.state_obj)
+            cs = self.chunk_size
+            self.chunks = [
+                blob[o : o + cs] for o in range(0, max(len(blob), 1), cs)
+            ] or [b""]
             timeout = proc.snapshot_ack_timeout_s
 
             def send(no, phase, data=b""):
@@ -437,18 +448,14 @@ class ServerProc:
                 peer.status = "normal"
             return
         meta, state = got
-        import pickle
-
-        blob = pickle.dumps(state)
-        csize = self.node.config.snapshot_chunk_size
-        chunks = [blob[o : o + csize] for o in range(0, max(len(blob), 1), csize)] or [b""]
         live_entries = (
             self.server.log.sparse_read(list(meta.live_indexes))
             if meta.live_indexes
             else []
         )
         sender = SnapshotSender(
-            self, to, meta, chunks, live_entries, self.server.current_term
+            self, to, meta, state, live_entries, self.server.current_term,
+            self.node.config.snapshot_chunk_size,
         )
         self._senders[to] = sender
         sender.start()

@@ -1277,16 +1277,19 @@ class Server:
                     SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
                 )
                 return effects
-            acc = self._snap_accept
-            if acc is None or acc["meta"].index != msg.meta.index:
-                acc = {"meta": msg.meta, "chunks": [], "next_chunk": 0, "from": from_peer}
-                self._snap_accept = acc
             if msg.chunk_phase == CHUNK_INIT:
-                acc["next_chunk"] = 1
+                # INIT always starts a fresh accumulator — a retried
+                # transfer at the same index must not extend stale chunks
+                self._snap_accept = {
+                    "meta": msg.meta, "chunks": [], "next_chunk": 1, "from": from_peer,
+                }
                 effects.append(
                     SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
                 )
                 return effects
+            acc = self._snap_accept
+            if acc is None or acc["meta"].index != msg.meta.index:
+                return effects  # no transfer in progress for this snapshot
             if msg.chunk_phase == CHUNK_PRE:
                 # sparse live entries preceding the snapshot body; writes
                 # are idempotent so pre chunks just advance the cursor
@@ -1370,11 +1373,9 @@ class Server:
 
     @staticmethod
     def _decode_snapshot(chunks: List[Any]) -> Any:
-        if len(chunks) == 1 and not isinstance(chunks[0], (bytes, bytearray)):
-            return chunks[0]  # in-proc transfer: machine state shipped direct
-        import pickle
+        from ra_tpu.log.snapshot import decode_snapshot_chunks
 
-        return pickle.loads(b"".join(chunks))
+        return decode_snapshot_chunks(chunks)
 
     # ------------------------------------------------------------------
     # await_condition role
@@ -1406,9 +1407,13 @@ class Server:
     # aux machine plumbing
 
     def _handle_aux(self, kind: str, cmd: Any, from_ref: Any, effects: EffectList) -> EffectList:
+        from ra_tpu.aux import AuxContext
+
         if not hasattr(self, "aux_state"):
             self.aux_state = self.machine.init_aux(self.cfg.cluster_name)
-        res = self.machine.handle_aux(self.role, kind, cmd, self.aux_state, self)
+        res = self.machine.handle_aux(
+            self.role, kind, cmd, self.aux_state, AuxContext(self)
+        )
         if res is None:
             return effects
         if len(res) == 2:

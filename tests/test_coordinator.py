@@ -220,6 +220,105 @@ def test_commit_with_one_dead_replica():
         leaderboard.clear()
 
 
+def test_batch_snapshot_catchup():
+    """A batch-backed member that lost everything catches up via the
+    chunked snapshot transfer from a batch-backed leader whose log is
+    compacted below the follower's needs."""
+    leaderboard.clear()
+    coords = {i: BatchCoordinator(f"sc{i}", capacity=64, num_peers=3)
+              for i in range(3)}
+    for c in coords.values():
+        c.start()
+    ids = [("s1", f"sc{i}") for i in range(3)]
+    try:
+        for c in coords.values():
+            c.add_group("s1", "sgrp", ids, adder())
+        coords[0].deliver(("s1", "sc0"), ElectionTimeout(), None)
+        await_(lambda: coords[0].by_name["s1"].role == C.R_LEADER, what="sc0 leads")
+        total = 0
+        for i in range(1, 11):
+            fut = api.Future()
+            coords[0].deliver(("s1", "sc0"),
+                              Command(kind=USR, data=i, reply_mode="await_consensus",
+                                      from_ref=fut), None)
+            total = fut.result(10)[1]
+        assert total == 55
+        # compact the leader's log below what a fresh member would need;
+        # the snapshot state must be the machine state AT index 9 (noop at
+        # idx 1, commands 1..8 at idx 2..9 -> sum = 36)
+        g0 = coords[0].by_name["s1"]
+        g0.log.update_release_cursor(9, ids, 0, 36)
+        assert g0.log.snapshot_index_term() is not None
+        # member sc2 loses everything (fresh coordinator, empty log)
+        coords[2].stop()
+        time.sleep(0.1)
+        coords[2] = BatchCoordinator("sc2", capacity=64, num_peers=3)
+        coords[2].start()
+        coords[2].add_group("s1", "sgrp", ids, adder())
+        # traffic triggers AER -> rejection -> rewind -> snapshot stream
+        fut = api.Future()
+        coords[0].deliver(("s1", "sc0"),
+                          Command(kind=USR, data=5, reply_mode="await_consensus",
+                                  from_ref=fut), None)
+        assert fut.result(10)[1] == 60
+        await_(lambda: coords[2].by_name["s1"].machine_state == 60,
+               timeout=20, what="batch snapshot catch-up")
+        g2 = coords[2].by_name["s1"]
+        assert g2.log.snapshot_index_term() is not None
+    finally:
+        for c in coords.values():
+            c.stop()
+        leaderboard.clear()
+
+
+def test_election_storm_after_leader_coordinator_death():
+    """BASELINE config 5 shape: many groups lose their leader at once
+    (the hosting coordinator dies) and all of them re-elect — the storm
+    rides the device vote-counting path on the survivors."""
+    leaderboard.clear()
+    G = 24
+    coords = {i: BatchCoordinator(f"es{i}", capacity=64, num_peers=3,
+                                  election_timeout_s=0.1, detector_poll_s=0.05)
+              for i in range(3)}
+    for c in coords.values():
+        c.start()
+    try:
+        for g in range(G):
+            ids = [(f"e{g}", f"es{i}") for i in range(3)]
+            for c in coords.values():
+                c.add_group(f"e{g}", f"egrp{g}", ids, adder())
+        for g in range(G):
+            coords[0].deliver((f"e{g}", "es0"), ElectionTimeout(), None)
+        await_(lambda: all(coords[0].by_name[f"e{g}"].role == C.R_LEADER
+                           for g in range(G)), what="es0 leads all")
+        t0 = time.monotonic()
+        coords[0].stop()
+        await_(
+            lambda: all(
+                any(coords[i].by_name[f"e{g}"].role == C.R_LEADER for i in (1, 2))
+                for g in range(G)
+            ),
+            timeout=30,
+            what="storm recovery",
+        )
+        recovery_s = time.monotonic() - t0
+        # every group accepts commands again
+        for g in range(G):
+            leader_i = next(i for i in (1, 2)
+                            if coords[i].by_name[f"e{g}"].role == C.R_LEADER)
+            fut = api.Future()
+            coords[leader_i].deliver((f"e{g}", f"es{leader_i}"),
+                                     Command(kind=USR, data=1,
+                                             reply_mode="await_consensus",
+                                             from_ref=fut), None)
+            assert fut.result(10)[0] == "ok"
+        assert recovery_s < 30
+    finally:
+        for i in (1, 2):
+            coords[i].stop()
+        leaderboard.clear()
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _warm_kernel():
     """Pre-compile the fused step for the shared (64, 3) shape so

@@ -186,3 +186,69 @@ def test_dbg_replay_log(tmp_path, cluster3):
     )
     assert state == 15
     assert len(seen) == 5
+
+
+# ---------------------------------------------------------------------------
+# aux machine + counters
+
+
+def test_aux_machine_context(cluster3):
+    from ra_tpu.machine import Machine
+
+    class AuxKv(Machine):
+        def init(self, config):
+            return {"n": 0}
+
+        def apply(self, meta, cmd, state):
+            state = dict(state)
+            state["n"] += cmd
+            return state, state["n"]
+
+        def init_aux(self, name):
+            return {"queries": 0}
+
+        def handle_aux(self, role, kind, cmd, aux_state, ctx):
+            aux_state = dict(aux_state)
+            aux_state["queries"] += 1
+            if cmd == "stats":
+                li, lt = ctx.last_index_term()
+                return {
+                    "n": ctx.machine_state()["n"],
+                    "members": len(ctx.members()),
+                    "commit": ctx.commit_index(),
+                    "last_index": li,
+                    "role": role,
+                    "queries": aux_state["queries"],
+                }, aux_state
+            if cmd == "read_log":
+                e = ctx.log_fetch(ctx.commit_index())
+                return ("entry", e.index if e else None), aux_state
+            return None, aux_state
+
+    ids = cluster3
+    api.start_cluster("auxc", AuxKv, ids)
+    api.process_command(ids[0], 7)
+    leader = api.wait_for_leader("auxc")
+    out = api.aux_command(leader, "stats")
+    assert out[0] == "ok"
+    stats = out[1]
+    assert stats["n"] == 7 and stats["members"] == 3
+    assert stats["commit"] >= 2 and stats["role"] == "leader"
+    out2 = api.aux_command(leader, "read_log")
+    assert out2[1][0] == "entry" and out2[1][1] == stats["commit"]
+    # aux state persists between calls
+    out3 = api.aux_command(leader, "stats")
+    assert out3[1]["queries"] == 3
+
+
+def test_counters_exposed(cluster3):
+    ids = cluster3
+    api.start_cluster("cnt", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    for _ in range(3):
+        api.process_command(ids[0], 1)
+    leader = api.wait_for_leader("cnt")
+    ov = api.counters_overview()
+    key = ("cnt", leader)
+    assert key in ov
+    assert ov[key]["commands"] >= 3
+    assert ov[key]["commit_index"] >= 4
