@@ -373,6 +373,28 @@ def transfer_leadership(server_id: ServerId, target: ServerId, timeout: float = 
     )
 
 
+def force_shrink_members_to_current_member(server_id: ServerId, timeout: float = 5.0):
+    """DANGEROUS disaster-recovery escape hatch: rewrite the member's
+    cluster to itself alone and elect it (reference:
+    ra:force_shrink_members_to_current_member)."""
+    fut = Future()
+    if not _try_send(server_id, ("force_shrink", fut)):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)
+
+
+def read_entries(server_id: ServerId, indexes, timeout: float = 5.0):
+    """External sparse log read (reference: ra_log_read_plan — read log
+    entries outside the server's apply path)."""
+    idxs = list(indexes)
+    fut = Future()
+    if not _try_send(
+        server_id, ("state_query", lambda s: s.log.sparse_read(idxs), fut)
+    ):
+        raise RaError(f"server {server_id} unreachable")
+    return fut.result(timeout)[1]
+
+
 def aux_command(server_id: ServerId, cmd: Any, timeout: float = 5.0):
     fut = Future()
     if not _try_send(server_id, ("aux", "call", cmd, fut)):

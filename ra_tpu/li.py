@@ -1,0 +1,23 @@
+"""Leaky integrator: smoothed commit-rate gauge.
+
+The role of the reference's ``ra_li`` (``src/ra_li.erl``, driving the
+``commit_rate`` overview gauge): an exponentially-decayed rate estimate
+updated from (count, dt) samples.
+"""
+
+from __future__ import annotations
+
+
+class LeakyIntegrator:
+    __slots__ = ("alpha", "rate")
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self.rate = 0.0
+
+    def sample(self, count: int, dt_s: float) -> float:
+        if dt_s <= 0:
+            return self.rate
+        inst = count / dt_s
+        self.rate = self.alpha * inst + (1 - self.alpha) * self.rate
+        return self.rate

@@ -772,6 +772,25 @@ class BatchCoordinator:
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "force_shrink":
+            # disaster recovery: restrict quorum to this member (slots are
+            # kept stable; only the voting/active masks shrink) and elect
+            onehot = np.zeros(self.P, dtype=bool)
+            onehot[g.self_slot] = True
+            self.state = self.state._replace(
+                voting=self.state.voting.at[g.gid].set(jnp.asarray(onehot)),
+                active=self.state.active.at[g.gid].set(jnp.asarray(onehot)),
+            )
+            self.state = C.set_roles(
+                self.state,
+                jnp.asarray([g.gid], jnp.int32),
+                jnp.asarray([C.R_PRE_VOTE], jnp.int32),
+            )
+            g.role = C.R_PRE_VOTE
+            self._hot.add(g.gid)
+            if len(msg) > 1 and msg[1] is not None:
+                self._reply(msg[1], ("ok", None))
+            return
         if isinstance(msg, InstallSnapshotRpc):
             self._receive_snapshot_chunk(g, msg, from_sid)
             return

@@ -192,6 +192,14 @@ class ServerProc:
         self._election_ref: Optional[int] = None
         self._tick_ref: Optional[int] = None
         self.last_leader_contact: float = time.monotonic()
+        # commit-rate gauge (reference: ra_li leaky integrator driving the
+        # commit_rate overview gauge)
+        from ra_tpu.li import LeakyIntegrator
+
+        self._commit_rate = LeakyIntegrator()
+        # seed with the recovered commit index so the first sample
+        # measures new traffic, not the entire recovered history
+        self._last_commit_sample = (time.monotonic(), server.commit_index)
         self._senders: Dict[ServerId, SnapshotSender] = {}
         self._machine_timers: Dict[Any, int] = {}
         self.running = True
@@ -248,14 +256,16 @@ class ServerProc:
             else:
                 if isinstance(msg, FromPeer):
                     self._note_contact(msg)
-                elif isinstance(msg, Tick) and server.role == LEADER:
-                    # reconnect probing: peers marked disconnected by
-                    # failed sends are retried once reachable again (the
-                    # reference flips status on nodeup; proc restarts on a
-                    # live node need the same)
-                    for sid, p in server.peers().items():
-                        if p.status == "disconnected" and self.transport.proc_alive(sid):
-                            p.status = "normal"
+                elif isinstance(msg, Tick):
+                    self._sample_commit_rate()
+                    if server.role == LEADER:
+                        # reconnect probing: peers marked disconnected by
+                        # failed sends are retried once reachable again
+                        # (the reference flips status on nodeup; proc
+                        # restarts on a live node need the same)
+                        for sid, p in server.peers().items():
+                            if p.status == "disconnected" and self.transport.proc_alive(sid):
+                                p.status = "normal"
                 effects = server.handle(msg)
             self._execute(effects)
             i += 1
@@ -383,6 +393,16 @@ class ServerProc:
             return
         self.enqueue(Tick(now_ms=int(time.time() * 1000)))
         self._set_tick_timer()
+
+    def _sample_commit_rate(self) -> None:
+        """Runs on the actor thread (single-owner server state)."""
+        now = time.monotonic()
+        prev_t, prev_ci = self._last_commit_sample
+        ci = self.server.commit_index
+        rate = self._commit_rate.sample(max(0, ci - prev_ci), now - prev_t)
+        self._last_commit_sample = (now, ci)
+        if self.server.counter is not None:
+            self.server.counter.put("commit_rate", int(rate))
 
     def arm_election_timer(self, immediate: bool = False) -> None:
         from ra_tpu.runtime.timers import randomized_election_timeout

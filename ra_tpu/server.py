@@ -318,6 +318,8 @@ class Server:
     def handle(self, msg: Any, from_peer: Optional[ServerId] = None) -> EffectList:
         if isinstance(msg, FromPeer):
             return self.handle(msg.msg, from_peer=msg.peer)
+        if isinstance(msg, tuple) and msg and msg[0] == "force_shrink":
+            return self._force_shrink(msg[1] if len(msg) > 1 else None)
         handler = {
             FOLLOWER: self._handle_follower,
             PRE_VOTE: self._handle_pre_vote,
@@ -329,6 +331,23 @@ class Server:
         effects = handler(msg, from_peer)
         self._g("commit_index", self.commit_index)
         self._g("last_applied", self.last_applied)
+        return effects
+
+    def _force_shrink(self, from_ref: Any) -> EffectList:
+        """Escape hatch: rewrite the cluster to just this member and
+        elect (used when a majority is permanently lost — reference:
+        force_shrink_members_to_current_member,
+        src/ra_server_proc.erl:270-272). DANGEROUS: discards the other
+        members' votes; only for operator-driven disaster recovery."""
+        effects: EffectList = []
+        idx = self.log.next_index()
+        cmd = Command(kind=RA_CLUSTER_CHANGE, data=("replace", ((self.id, "voter"),)))
+        self._set_cluster({self.id: PeerState()}, idx, self.current_term)
+        self.log.append(Entry(index=idx, term=self.current_term, cmd=cmd))
+        self.cluster_change_permitted = False
+        self._call_for_election(effects)
+        if from_ref is not None:
+            effects.append(Reply(from_ref, ("ok", None)))
         return effects
 
     # ------------------------------------------------------------------
@@ -1005,6 +1024,13 @@ class Server:
                 new_cluster[member] = ps
         elif cmd.kind == RA_LEAVE:
             new_cluster.pop(cmd.data, None)
+        elif (
+            isinstance(cmd.data, tuple) and cmd.data and cmd.data[0] == "replace"
+        ):
+            # full-cluster replacement (force_shrink recovery marker)
+            new_cluster = {
+                member: PeerState(voter_status=vs) for member, vs in cmd.data[1]
+            }
         else:
             for member, voter_status in cmd.data:
                 if member in new_cluster:

@@ -1,0 +1,152 @@
+"""Rolling machine-version upgrades, disaster recovery (force shrink),
+external log reads and commit-rate gauges.
+
+Capability model: the reference's ra_machine_version_SUITE (rolling
+upgrades via restarts), force_shrink_members_to_current_member and
+ra_log_read_plan."""
+
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import Machine, SimpleMachine, VersionedMachine
+from ra_tpu.system import SystemConfig
+
+NODES = ("uA", "uB", "uC")
+
+
+class V0(Machine):
+    """Counter: plain addition."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        if isinstance(cmd, tuple):
+            return state, None  # ignore builtins
+        return state + cmd, state + cmd
+
+
+class V1(Machine):
+    """Upgraded: doubles additions; upgrade marker adds 1000."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        if isinstance(cmd, tuple) and cmd and cmd[0] == "machine_version":
+            return state + 1000, None
+        if isinstance(cmd, tuple):
+            return state, None
+        return state + 2 * cmd, state + 2 * cmd
+
+
+def old_machine():
+    return VersionedMachine({0: V0()})
+
+
+def new_machine():
+    return VersionedMachine({0: V0(), 1: V1()})
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    leaderboard.clear()
+    for n in NODES:
+        cfg = SystemConfig(name="up", data_dir=str(tmp_path))
+        api.start_node(n, cfg, election_timeout_s=0.1, tick_interval_s=0.05,
+                       detector_poll_s=0.05)
+    yield [("u1", "uA"), ("u2", "uB"), ("u3", "uC")]
+    for n in NODES:
+        try:
+            api.stop_node(n)
+        except Exception:
+            pass
+    leaderboard.clear()
+
+
+def test_rolling_machine_upgrade(cluster):
+    ids = cluster
+    api.start_cluster("upc", old_machine, ids)
+    r, _ = api.process_command(ids[0], 5)
+    assert r == 5  # V0 semantics
+    # rolling upgrade: replace the machine member by member via restart
+    from ra_tpu.runtime.transport import registry
+
+    for sid in ids:
+        node = registry().get(sid[1])
+        node.stop_server(sid[0])
+        uid = node.directory.uid_of(sid[0])
+        node._machines[uid] = new_machine()
+        rec = node.meta.fetch(uid, "__server_config__")
+        node.start_server(sid[0], rec["cluster"], new_machine(), rec["members"],
+                          uid=uid)
+        time.sleep(0.2)
+    # an upgraded member must lead for the version bump (noop carries it)
+    api.trigger_election(ids[0])
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        leader = leaderboard.lookup_leader("upc")
+        if leader and api._is_running(leader):
+            km = api.key_metrics(leader)
+            if km["machine_version"] == 1:
+                break
+        time.sleep(0.05)
+    km = api.key_metrics(leaderboard.lookup_leader("upc"))
+    assert km["machine_version"] == 1
+    # upgrade marker applied (+1000), then V1 doubles commands
+    r, _ = api.process_command(ids[0], 3, timeout=10, retry_on_timeout=True)
+    assert r == 5 + 1000 + 6
+    # all replicas converge on the upgraded semantics
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        vals = [api.local_query(sid, lambda s: s)[1] for sid in ids]
+        if vals == [1011, 1011, 1011]:
+            break
+        time.sleep(0.05)
+    assert vals == [1011, 1011, 1011]
+
+
+def test_force_shrink_recovers_from_majority_loss(cluster):
+    ids = cluster
+    api.start_cluster("fs", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    api.process_command(ids[0], 7)
+    survivor = api.wait_for_leader("fs")
+    # both other members die permanently
+    for sid in ids:
+        if sid != survivor:
+            api.stop_server(sid)
+    # commands cannot commit (no quorum)
+    with pytest.raises(api.RaError):
+        api.process_command(survivor, 1, timeout=1.0)
+    # operator escape hatch
+    out = api.force_shrink_members_to_current_member(survivor)
+    assert out[0] == "ok"
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if leaderboard.lookup_leader("fs") == survivor:
+            try:
+                r, _ = api.process_command(survivor, 2, timeout=2)
+                break
+            except api.RaError:
+                pass
+        time.sleep(0.05)
+    assert r == 10  # 7 + the stuck 1 (committed by the shrunk cluster) + 2
+    mem, _ = api.members(survivor)
+    assert mem == [survivor]
+
+
+def test_read_entries_and_commit_rate(cluster):
+    ids = cluster
+    api.start_cluster("rd", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+    for i in range(5):
+        api.process_command(ids[0], i)
+    leader = api.wait_for_leader("rd")
+    entries = api.read_entries(leader, [2, 3, 4])
+    assert [e.index for e in entries] == [2, 3, 4]
+    assert entries[0].cmd.data == 0
+    # commit-rate gauge updates on ticks
+    time.sleep(0.3)
+    ov = api.counters_overview()
+    assert ("rd", leader) in ov and "commit_rate" in ov[("rd", leader)]
