@@ -288,8 +288,14 @@ def consistent_query(
 
 
 def members(server_id: ServerId, timeout: float = 5.0) -> Tuple[List[ServerId], ServerId]:
+    def get_members(s):
+        # Server exposes members() as a method; coordinator GroupHost as
+        # a plain attribute
+        m = s.members
+        return list(m() if callable(m) else m)
+
     fut = Future()
-    if not _try_send(server_id, ("state_query", lambda s: list(s.members()), fut)):
+    if not _try_send(server_id, ("state_query", get_members, fut)):
         raise RaError(f"server {server_id} unreachable")
     out = fut.result(timeout)
     return out[1], out[2]

@@ -491,6 +491,54 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
 consensus_step = jax.jit(consensus_step_impl, donate_argnums=(0,))
 
 
+# Packed interface: the host coordinator ships the whole mailbox as ONE
+# (len(MBOX_FIELDS), G) int32 array and receives the egress as ONE
+# (len(EGRESS_FIELDS), G) int32 array — a single transfer each way per
+# step instead of ~35 small ones. reply_to is intentionally omitted from
+# the egress pack (hosts address replies via the consumed message's
+# sender).
+MBOX_FIELDS = [
+    "msg_type", "sender_slot", "term", "prev_idx", "prev_term",
+    "num_entries", "entries_last_term", "leader_commit", "success",
+    "reply_next_idx", "reply_last_idx", "reply_last_term", "cand_last_idx",
+    "cand_last_term", "cand_machine_version", "host_term_idx",
+    "host_term_val",
+]
+EGRESS_FIELDS = [
+    "send_reply", "reply_type", "term", "success", "next_index",
+    "last_index", "last_term", "aer_code", "became_leader",
+    "became_candidate", "commit_advanced_to", "needs_host",
+    "term_or_vote_changed", "role", "leader_slot", "agreed_idx",
+]
+
+
+# packed lists must track the namedtuples: a drifted field name would be
+# silently dropped on the host side
+assert set(MBOX_FIELDS) == set(Mailbox._fields), (
+    set(MBOX_FIELDS) ^ set(Mailbox._fields)
+)
+assert set(EGRESS_FIELDS) == set(Egress._fields) - {"reply_to"}, (
+    set(EGRESS_FIELDS) ^ (set(Egress._fields) - {"reply_to"})
+)
+
+
+def _consensus_step_packed_impl(state: GroupState, packed: jax.Array):
+    rows = {name: packed[i] for i, name in enumerate(MBOX_FIELDS)}
+    rows["success"] = rows["success"] != 0
+    mbox = Mailbox(**rows)
+    new_state, eg = consensus_step_impl(state, mbox)
+    out = jnp.stack(
+        [
+            getattr(eg, name).astype(jnp.int32)
+            for name in EGRESS_FIELDS
+        ]
+    )
+    return new_state, out
+
+
+consensus_step_packed = jax.jit(_consensus_step_packed_impl, donate_argnums=(0,))
+
+
 # ---------------------------------------------------------------------------
 # host-side helpers for log-tail maintenance
 

@@ -302,18 +302,16 @@ class BatchCoordinator:
         self._pending_scatters = []
 
         if appended:
-            gids = jnp.asarray([a[0] for a in appended], jnp.int32)
-            idxs = jnp.asarray([a[1] for a in appended], jnp.int32)
-            terms = jnp.asarray([a[2] for a in appended], jnp.int32)
+            gids, idxs, terms = self._pad3(appended)
             self.state = C.record_appended(self.state, gids, idxs, terms)
         if written:
-            gids = jnp.asarray([w[0] for w in written], jnp.int32)
-            idxs = jnp.asarray([w[1] for w in written], jnp.int32)
+            gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written])
             self.state = C.record_written(self.state, gids, idxs)
 
-        mbox, consumed = self._build_mailbox()
-        self.state, egress = C.consensus_step(self.state, mbox)
-        eg = {k: np.asarray(v) for k, v in egress._asdict().items()}
+        packed, consumed = self._build_mailbox()
+        self.state, eg_packed = C.consensus_step_packed(self.state, packed)
+        eg_np = np.asarray(eg_packed)
+        eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
         self.steps += 1
         self.msgs_processed += len(consumed)
         self._process_egress(eg, consumed, aer_dirty)
@@ -322,6 +320,23 @@ class BatchCoordinator:
             self._handle_rare(g, msg, from_sid)
         self._send_aers(aer_dirty)
         return True
+
+    def _pad3(self, triples):
+        """Pad scatter batches to power-of-two buckets so XLA compiles a
+        handful of shapes instead of one per batch length. Pads use an
+        out-of-bounds group id, which jitted scatters drop."""
+        n = len(triples)
+        cap = 1
+        while cap < n:
+            cap <<= 1
+        pad = (self.capacity, 0, 0)
+        full = list(triples) + [pad] * (cap - n)
+        arr = np.asarray(full, np.int32)
+        return (
+            jnp.asarray(arr[:, 0]),
+            jnp.asarray(arr[:, 1]),
+            jnp.asarray(arr[:, 2]),
+        )
 
     # -- ingress routing ---------------------------------------------------
 
@@ -385,27 +400,14 @@ class BatchCoordinator:
 
     # -- mailbox build -----------------------------------------------------
 
+    # packed mailbox row indexes (see C.MBOX_FIELDS)
+    _R = {name: i for i, name in enumerate(C.MBOX_FIELDS)}
+
     def _build_mailbox(self):
         cap = self.capacity
-        cols = {
-            "msg_type": np.zeros(cap, np.int32),
-            "sender_slot": np.zeros(cap, np.int32),
-            "term": np.zeros(cap, np.int32),
-            "prev_idx": np.zeros(cap, np.int32),
-            "prev_term": np.zeros(cap, np.int32),
-            "num_entries": np.zeros(cap, np.int32),
-            "entries_last_term": np.zeros(cap, np.int32),
-            "leader_commit": np.zeros(cap, np.int32),
-            "success": np.zeros(cap, bool),
-            "reply_next_idx": np.zeros(cap, np.int32),
-            "reply_last_idx": np.zeros(cap, np.int32),
-            "reply_last_term": np.zeros(cap, np.int32),
-            "cand_last_idx": np.zeros(cap, np.int32),
-            "cand_last_term": np.zeros(cap, np.int32),
-            "cand_machine_version": np.zeros(cap, np.int32),
-            "host_term_idx": np.full(cap, -1, np.int32),
-            "host_term_val": np.full(cap, -1, np.int32),
-        }
+        packed = np.zeros((len(C.MBOX_FIELDS), cap), np.int32)
+        packed[self._R["host_term_idx"]].fill(-1)
+        packed[self._R["host_term_val"]].fill(-1)
         consumed: Dict[int, Tuple[Any, Any]] = {}
         hot = self._hot
         self._hot = set()
@@ -414,58 +416,57 @@ class BatchCoordinator:
             if g is None:
                 continue
             if g.host_term_hint is not None:
-                cols["host_term_idx"][i], cols["host_term_val"][i] = g.host_term_hint
+                packed[self._R["host_term_idx"], i] = g.host_term_hint[0]
+                packed[self._R["host_term_val"], i] = g.host_term_hint[1]
                 g.host_term_hint = None
             if not g.inbox:
                 continue
             from_sid, msg = g.inbox.popleft()
             consumed[i] = (from_sid, msg)
-            self._encode(g, from_sid, msg, cols, i)
+            self._encode(g, from_sid, msg, packed, i)
             if g.inbox:
                 self._hot.add(i)  # more queued: stay hot for next step
-        mbox = C.Mailbox(**{k: jnp.asarray(v) for k, v in cols.items()})
-        return mbox, consumed
+        return jnp.asarray(packed), consumed
 
-    def _encode(self, g: GroupHost, from_sid, msg, cols, i) -> None:
-        cols["sender_slot"][i] = g.slot_of(from_sid) if from_sid else 0
+    def _encode(self, g: GroupHost, from_sid, msg, p, i) -> None:
+        R = self._R
+        p[R["sender_slot"], i] = g.slot_of(from_sid) if from_sid else 0
         if isinstance(msg, AppendEntriesRpc):
-            cols["msg_type"][i] = C.MSG_AER
-            cols["term"][i] = msg.term
-            cols["prev_idx"][i] = msg.prev_log_index
-            cols["prev_term"][i] = msg.prev_log_term
-            cols["num_entries"][i] = len(msg.entries)
-            cols["entries_last_term"][i] = (
-                msg.entries[-1].term if msg.entries else 0
-            )
-            cols["leader_commit"][i] = msg.leader_commit
+            p[R["msg_type"], i] = C.MSG_AER
+            p[R["term"], i] = msg.term
+            p[R["prev_idx"], i] = msg.prev_log_index
+            p[R["prev_term"], i] = msg.prev_log_term
+            p[R["num_entries"], i] = len(msg.entries)
+            p[R["entries_last_term"], i] = msg.entries[-1].term if msg.entries else 0
+            p[R["leader_commit"], i] = msg.leader_commit
         elif isinstance(msg, AppendEntriesReply):
-            cols["msg_type"][i] = C.MSG_AER_REPLY
-            cols["term"][i] = msg.term
-            cols["success"][i] = msg.success
-            cols["reply_next_idx"][i] = msg.next_index
-            cols["reply_last_idx"][i] = msg.last_index
-            cols["reply_last_term"][i] = msg.last_term
+            p[R["msg_type"], i] = C.MSG_AER_REPLY
+            p[R["term"], i] = msg.term
+            p[R["success"], i] = 1 if msg.success else 0
+            p[R["reply_next_idx"], i] = msg.next_index
+            p[R["reply_last_idx"], i] = msg.last_index
+            p[R["reply_last_term"], i] = msg.last_term
         elif isinstance(msg, RequestVoteRpc):
-            cols["msg_type"][i] = C.MSG_VOTE_REQ
-            cols["term"][i] = msg.term
-            cols["sender_slot"][i] = g.slot_of(msg.candidate_id)
-            cols["cand_last_idx"][i] = msg.last_log_index
-            cols["cand_last_term"][i] = msg.last_log_term
+            p[R["msg_type"], i] = C.MSG_VOTE_REQ
+            p[R["term"], i] = msg.term
+            p[R["sender_slot"], i] = g.slot_of(msg.candidate_id)
+            p[R["cand_last_idx"], i] = msg.last_log_index
+            p[R["cand_last_term"], i] = msg.last_log_term
         elif isinstance(msg, RequestVoteResult):
-            cols["msg_type"][i] = C.MSG_VOTE_REPLY
-            cols["term"][i] = msg.term
-            cols["success"][i] = msg.vote_granted
+            p[R["msg_type"], i] = C.MSG_VOTE_REPLY
+            p[R["term"], i] = msg.term
+            p[R["success"], i] = 1 if msg.vote_granted else 0
         elif isinstance(msg, PreVoteRpc):
-            cols["msg_type"][i] = C.MSG_PREVOTE_REQ
-            cols["term"][i] = msg.term
-            cols["sender_slot"][i] = g.slot_of(msg.candidate_id)
-            cols["cand_last_idx"][i] = msg.last_log_index
-            cols["cand_last_term"][i] = msg.last_log_term
-            cols["cand_machine_version"][i] = msg.machine_version
+            p[R["msg_type"], i] = C.MSG_PREVOTE_REQ
+            p[R["term"], i] = msg.term
+            p[R["sender_slot"], i] = g.slot_of(msg.candidate_id)
+            p[R["cand_last_idx"], i] = msg.last_log_index
+            p[R["cand_last_term"], i] = msg.last_log_term
+            p[R["cand_machine_version"], i] = msg.machine_version
         elif isinstance(msg, PreVoteResult):
-            cols["msg_type"][i] = C.MSG_PREVOTE_REPLY
-            cols["term"][i] = msg.term
-            cols["success"][i] = msg.vote_granted
+            p[R["msg_type"], i] = C.MSG_PREVOTE_REPLY
+            p[R["term"], i] = msg.term
+            p[R["success"], i] = 1 if msg.vote_granted else 0
 
     # -- egress ------------------------------------------------------------
 
@@ -773,13 +774,25 @@ class BatchCoordinator:
             self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
             return
         if isinstance(msg, tuple) and msg and msg[0] == "force_shrink":
-            # disaster recovery: restrict quorum to this member (slots are
-            # kept stable; only the voting/active masks shrink) and elect
+            # disaster recovery: restrict the cluster to this member and
+            # elect. Mirrors the Server path: membership shrinks, a
+            # durable 'replace' marker is appended (meaningful when the
+            # group's log is persistent), and an election follows.
+            me = (g.name, self.name)
+            idx = g.log.next_index()
+            g.log.append(Entry(index=idx, term=g.term, cmd=Command(
+                kind="ra_cluster_change", data=("replace", ((me, "voter"),)))))
+            self._pending_scatters.append(("a", g.gid, idx, g.term))
+            g.members = [me]
+            g.self_slot = 0
+            g.next_index = [idx + 1]
+            g.commit_sent = [0]
             onehot = np.zeros(self.P, dtype=bool)
-            onehot[g.self_slot] = True
+            onehot[0] = True
             self.state = self.state._replace(
                 voting=self.state.voting.at[g.gid].set(jnp.asarray(onehot)),
                 active=self.state.active.at[g.gid].set(jnp.asarray(onehot)),
+                self_slot=self.state.self_slot.at[g.gid].set(0),
             )
             self.state = C.set_roles(
                 self.state,

@@ -402,7 +402,8 @@ class ServerProc:
         rate = self._commit_rate.sample(max(0, ci - prev_ci), now - prev_t)
         self._last_commit_sample = (now, ci)
         if self.server.counter is not None:
-            self.server.counter.put("commit_rate", int(rate))
+            # round, don't truncate: sub-1/s rates must not read as idle
+            self.server.counter.put("commit_rate", int(round(rate)))
 
     def arm_election_timer(self, immediate: bool = False) -> None:
         from ra_tpu.runtime.timers import randomized_election_timeout
