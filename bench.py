@@ -31,8 +31,16 @@ def ensure_live_backend(timeout_s: float = 120.0) -> None:
     """The TPU tunnel can wedge (backend init blocks forever on a TCP
     read). Probe device init in a subprocess; if it does not come up in
     time, force this process onto CPU so the bench always completes."""
-    if os.environ.get("RA_BENCH_PLATFORM") or os.environ.get("JAX_PLATFORMS") == "cpu":
-        return  # operator already pinned a platform: skip the probe
+    pinned = os.environ.get("RA_BENCH_PLATFORM")
+    if pinned:
+        # operator pinned a platform explicitly: apply it and skip the probe
+        os.environ["JAX_PLATFORMS"] = pinned
+        import jax
+
+        jax.config.update("jax_platforms", pinned)
+        return
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return  # already on CPU: nothing to probe
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],

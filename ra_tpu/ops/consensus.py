@@ -47,6 +47,23 @@ MSG_VOTE_REPLY = 4
 MSG_PREVOTE_REQ = 5
 MSG_PREVOTE_REPLY = 6
 
+# quorum-scan backend: "sort" (jnp.sort; XLA fuses it well) or "pallas"
+# (the fixed odd-even network kernel in ra_tpu.ops.pallas_quorum).
+# Switch with configure(quorum_backend=...) BEFORE the first step — it
+# clears the jit caches so the choice takes effect.
+_QUORUM_BACKEND = "sort"
+
+
+def configure(quorum_backend: str = None) -> None:
+    global _QUORUM_BACKEND
+    if quorum_backend is not None:
+        if quorum_backend not in ("sort", "pallas"):
+            raise ValueError(f"unknown quorum_backend {quorum_backend!r}")
+        _QUORUM_BACKEND = quorum_backend
+        consensus_step.clear_cache()
+        consensus_step_packed.clear_cache()
+
+
 # roles
 R_FOLLOWER = 0
 R_PRE_VOTE = 1
@@ -408,10 +425,21 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
     # ---------------- quorum commit scan (leaders, every step) ----------------
     is_self = jnp.arange(P)[None, :] == state.self_slot[:, None]
     eff_match = jnp.where(is_self, state.written_index[:, None], match3)
-    eff_match = jnp.where(state.voting & state.active, eff_match, -1)
-    srt = jnp.sort(eff_match, axis=-1)  # ascending; non-voters (-1) first
-    pos = jnp.clip(P - 1 - n_voters // 2, 0, P - 1)
-    agreed = jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
+    if _QUORUM_BACKEND == "pallas":
+        from ra_tpu.ops.pallas_quorum import agreed_commit_pallas
+
+        agreed = agreed_commit_pallas(
+            eff_match,
+            state.voting & state.active,
+            n_voters,
+            # compiled pallas needs a real TPU; elsewhere run interpreted
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        eff = jnp.where(state.voting & state.active, eff_match, -1)
+        srt = jnp.sort(eff, axis=-1)  # ascending; non-voters (-1) first
+        pos = jnp.clip(P - 1 - n_voters // 2, 0, P - 1)
+        agreed = jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
     agreed_term, agreed_known = term_at(
         state._replace(
             last_index=last_index2,

@@ -88,16 +88,16 @@ def test_randomized_kv_consistency(tmp_path, seed):
         leader = api.wait_for_leader("kvh", timeout=10)
         for k in keys:
             allowed = {reference.get(k)} | indeterminate.get(k, set())
-            got = None
             while time.monotonic() < deadline:
                 try:
-                    got = kv_get(api, leader, k, timeout=5)
-                    if got in allowed:
+                    if kv_get(api, leader, k, timeout=5) in allowed:
                         break
                 except api.RaError:
                     pass
                 time.sleep(0.05)
-            assert got in allowed, (k, got, allowed)
+            # final read outside the retry loop: a persistently failing
+            # read path must fail the test, not pass vacuously
+            assert kv_get(api, leader, k, timeout=5) in allowed, (k, allowed)
     finally:
         testing.heal_all()
         for n in NODES:
