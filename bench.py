@@ -58,6 +58,19 @@ def ensure_live_backend(timeout_s: float = 120.0) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def _retry_on_cpu_or_fail() -> None:
+    """An incomplete pipeline run on a device platform (e.g. a
+    high-latency tunneled chip) re-execs the whole bench pinned to CPU so
+    the driver still gets a valid number; on CPU it is a hard failure."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        raise SystemExit(1)
+    print("bench: retrying on CPU", file=sys.stderr)
+    env = dict(os.environ, RA_BENCH_PLATFORM="cpu", PYTHONPATH="")
+    os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def bench_pipeline(groups: int, cmds: int) -> dict:
     from ra_tpu.machine import SimpleMachine
     from ra_tpu.ops import consensus as C
@@ -91,10 +104,8 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
         if not all(
             coords[0].by_name[f"g{g}"].role == C.R_LEADER for g in range(groups)
         ):
-            import sys
-
             print("bench error: leader election incomplete", file=sys.stderr)
-            raise SystemExit(1)
+            _retry_on_cpu_or_fail()
 
         t0 = time.perf_counter()
         for _ in range(cmds):
@@ -115,8 +126,6 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
         if not all(
             coords[0].by_name[f"g{g}"].machine_state == cmds for g in range(groups)
         ):
-            import sys
-
             done = sum(
                 coords[0].by_name[f"g{g}"].machine_state == cmds
                 for g in range(groups)
@@ -124,7 +133,7 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
             print(
                 f"bench error: only {done}/{groups} groups completed", file=sys.stderr
             )
-            raise SystemExit(1)
+            _retry_on_cpu_or_fail()
         total = groups * cmds
         import jax
 

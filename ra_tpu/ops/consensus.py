@@ -216,6 +216,19 @@ def empty_mailbox(num_groups: int) -> Mailbox:
 # device-side term lookup
 
 
+def agreed_commit_sort(
+    match: jax.Array, voting: jax.Array, nvoters: jax.Array
+) -> jax.Array:
+    """Quorum scan, jnp.sort formulation — the single shared
+    implementation (the pallas kernel's parity reference and the default
+    in-step backend)."""
+    p = match.shape[-1]
+    eff = jnp.where(voting, match, -1)
+    srt = jnp.sort(eff, axis=-1)  # ascending; non-voters (-1) first
+    pos = jnp.clip(p - 1 - nvoters // 2, 0, p - 1)
+    return jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
+
+
 def term_at(state: GroupState, idx: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """(term, known) — term of the entry at ``idx`` from the ring-buffer
     window / snapshot boundary. known=False → host fallback needed."""
@@ -425,21 +438,20 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
     # ---------------- quorum commit scan (leaders, every step) ----------------
     is_self = jnp.arange(P)[None, :] == state.self_slot[:, None]
     eff_match = jnp.where(is_self, state.written_index[:, None], match3)
-    if _QUORUM_BACKEND == "pallas":
+    if _QUORUM_BACKEND == "pallas" and P <= 8:
         from ra_tpu.ops.pallas_quorum import agreed_commit_pallas
 
         agreed = agreed_commit_pallas(
             eff_match,
             state.voting & state.active,
             n_voters,
-            # compiled pallas needs a real TPU; elsewhere run interpreted
-            interpret=jax.default_backend() != "tpu",
+            # interpret only where no TPU compiler exists; note the real
+            # chip's platform name here is "axon", not "tpu"
+            interpret=jax.default_backend() == "cpu",
         )
     else:
-        eff = jnp.where(state.voting & state.active, eff_match, -1)
-        srt = jnp.sort(eff, axis=-1)  # ascending; non-voters (-1) first
-        pos = jnp.clip(P - 1 - n_voters // 2, 0, P - 1)
-        agreed = jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
+        # P > 8 exceeds the pallas kernel's sublane width: sort fallback
+        agreed = agreed_commit_sort(eff_match, state.voting & state.active, n_voters)
     agreed_term, agreed_known = term_at(
         state._replace(
             last_index=last_index2,

@@ -91,9 +91,8 @@ def agreed_commit_pallas(
 
 
 def agreed_commit_reference(match, voting, nvoters):
-    """The jnp.sort formulation used inside consensus_step (for parity)."""
-    p = match.shape[-1]
-    eff = jnp.where(voting, match, -1)
-    srt = jnp.sort(eff, axis=-1)
-    pos = jnp.clip(p - 1 - nvoters // 2, 0, p - 1)
-    return jnp.take_along_axis(srt, pos[:, None], axis=-1).squeeze(-1)
+    """The exact formulation consensus_step's sort backend executes —
+    shared, so parity tests cover the production path."""
+    from ra_tpu.ops.consensus import agreed_commit_sort
+
+    return agreed_commit_sort(match, voting, nvoters)

@@ -88,16 +88,22 @@ def test_randomized_kv_consistency(tmp_path, seed):
         leader = api.wait_for_leader("kvh", timeout=10)
         for k in keys:
             allowed = {reference.get(k)} | indeterminate.get(k, set())
+            # a SUCCESSFUL read matching `allowed` must be observed —
+            # transient RaErrors retry, but all-reads-failing must fail
+            # the test rather than pass vacuously
+            observed = False
+            got = None
             while time.monotonic() < deadline:
                 try:
-                    if kv_get(api, leader, k, timeout=5) in allowed:
-                        break
+                    got = kv_get(api, leader, k, timeout=5)
+                    observed = True
+                    if got in allowed:
+                        break  # converged
                 except api.RaError:
                     pass
                 time.sleep(0.05)
-            # final read outside the retry loop: a persistently failing
-            # read path must fail the test, not pass vacuously
-            assert kv_get(api, leader, k, timeout=5) in allowed, (k, allowed)
+            assert observed, f"no successful read of {k} before the deadline"
+            assert got in allowed, (k, got, allowed)
     finally:
         testing.heal_all()
         for n in NODES:
