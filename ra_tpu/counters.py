@@ -80,6 +80,7 @@ SEGMENT_WRITER_FIELDS: List[FieldSpec] = [
     ("entries_flushed", "counter", "entries flushed to segments"),
     ("segments_created", "counter", "segment files created"),
     ("bytes_flushed", "counter", "bytes flushed"),
+    ("flush_errors", "counter", "flush jobs that raised (retried/retained)"),
 ]
 
 

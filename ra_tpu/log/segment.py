@@ -56,7 +56,11 @@ class SegmentWriterHandle:
                 if idx == 0:
                     break
                 self.count += 1
-                self.range = (self.range[0], idx) if self.range else (idx, idx)
+                self.range = (
+                    (min(self.range[0], idx), max(self.range[1], idx))
+                    if self.range
+                    else (idx, idx)
+                )
                 end = max(end, off + ln)
             self._data_end = end
 
@@ -78,7 +82,13 @@ class SegmentWriterHandle:
         self._f.write(_SLOT.pack(idx, term, off, len(payload), crc))
         self._data_end = off + len(payload)
         self.count += 1
-        self.range = (self.range[0], idx) if self.range else (idx, idx)
+        # min/max (not blind extend): appends may arrive out of index
+        # order across retry/recovery replays; ranges must never invert
+        self.range = (
+            (min(self.range[0], idx), max(self.range[1], idx))
+            if self.range
+            else (idx, idx)
+        )
 
     def sync(self) -> None:
         self._f.flush()

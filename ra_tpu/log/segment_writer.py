@@ -15,6 +15,7 @@ tests).
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import threading
@@ -29,8 +30,12 @@ from ra_tpu.utils.seq import Seq
 
 NotifyFn = Callable[[str, object], None]
 
+logger = logging.getLogger("ra_tpu")
+
 
 class SegmentWriter:
+    MAX_FLUSH_ATTEMPTS = 5
+
     def __init__(
         self,
         data_dir: str,
@@ -66,7 +71,7 @@ class SegmentWriter:
         with self._cv:
             if self._closed:
                 return
-            self._queue.append((seqs, wal_file))
+            self._queue.append((dict(seqs), wal_file, 0))
             self._idle.clear()
             self._cv.notify()
         if self._thread is None:
@@ -111,44 +116,70 @@ class SegmentWriter:
                 if not self._queue:
                     self._idle.set()
                     return
-                seqs, wal_file = self._queue.popleft()
+                seqs, wal_file, attempt = self._queue.popleft()
             try:
                 self._flush_job(seqs)
-            finally:
-                if wal_file and os.path.exists(wal_file):
-                    os.unlink(wal_file)
+            except Exception as exc:  # noqa: BLE001
+                # The WAL file is the only durable copy of these entries
+                # until the flush lands in segments: never unlink it on
+                # failure, and never let one bad flush kill the writer.
+                # Retry with backoff (requeued at the FRONT so per-uid
+                # flush order is preserved); after that, leave the WAL
+                # file on disk so boot-time recovery can replay it.
+                self.counter.incr("flush_errors")
+                if attempt + 1 < self.MAX_FLUSH_ATTEMPTS:
+                    with self._cv:
+                        self._queue.appendleft((seqs, wal_file, attempt + 1))
+                        # interruptible backoff (close() notifies); total
+                        # worst-case stall per job is < 1s
+                        self._cv.wait(timeout=min(0.05 * (2 ** attempt), 0.4))
+                else:
+                    logger.error(
+                        "segment_writer: flush failed after %d attempts, "
+                        "retaining %r: %r", attempt + 1, wal_file, exc,
+                    )
+                continue
+            if wal_file and os.path.exists(wal_file):
+                os.unlink(wal_file)
 
     def _flush_job(self, seqs: Dict[str, Seq]) -> None:
-        for uid, seq in seqs.items():
-            # flush floor: skip dead indexes below the snapshot, keep live
-            # ones (reference: start_index/smallest_live_idx truncation,
-            # src/ra_log_segment_writer.erl:268-390)
-            snap_idx = self.tables.snapshot_index(uid)
-            live = self.tables.live_indexes(uid)
-            keep = seq.floor(snap_idx + 1).union(seq.intersect(live))
-            mt = self.tables.mem_table(uid)
-            new_refs: List[Tuple[str, Tuple[int, int]]] = []
-            handle = self._open_segment(uid)
-            wrote = 0
-            for idx in keep:
-                entry = mt.get(idx)
-                if entry is None:
-                    continue  # already truncated/compacted away
-                if handle.is_full():
-                    handle.sync()
-                    handle.close()
-                    if handle.range:
-                        new_refs.append((os.path.basename(handle.path), handle.range))
-                    handle = self._roll_segment(uid)
-                handle.append(entry.index, entry.term, encode_cmd(entry.cmd))
-                wrote += 1
-            if wrote:
+        # uids are removed from ``seqs`` as they complete so a retried
+        # job (requeued by _drain on failure) never replays finished
+        # uids' appends/notifications
+        for uid in list(seqs):
+            self._flush_uid(uid, seqs[uid])
+            del seqs[uid]
+
+    def _flush_uid(self, uid: str, seq: Seq) -> None:
+        # flush floor: skip dead indexes below the snapshot, keep live
+        # ones (reference: start_index/smallest_live_idx truncation,
+        # src/ra_log_segment_writer.erl:268-390)
+        snap_idx = self.tables.snapshot_index(uid)
+        live = self.tables.live_indexes(uid)
+        keep = seq.floor(snap_idx + 1).union(seq.intersect(live))
+        mt = self.tables.mem_table(uid)
+        new_refs: List[Tuple[str, Tuple[int, int]]] = []
+        handle = self._open_segment(uid)
+        wrote = 0
+        for idx in keep:
+            entry = mt.get(idx)
+            if entry is None:
+                continue  # already truncated/compacted away
+            if handle.is_full():
                 handle.sync()
-                self.counter.incr("entries_flushed", wrote)
-            self.counter.incr("mem_tables_flushed")
-            if handle.range:
-                new_refs.append((os.path.basename(handle.path), handle.range))
-            self.notify(uid, ("segments", seq, new_refs))
+                handle.close()
+                if handle.range:
+                    new_refs.append((os.path.basename(handle.path), handle.range))
+                handle = self._roll_segment(uid)
+            handle.append(entry.index, entry.term, encode_cmd(entry.cmd))
+            wrote += 1
+        if wrote:
+            handle.sync()
+            self.counter.incr("entries_flushed", wrote)
+        self.counter.incr("mem_tables_flushed")
+        if handle.range:
+            new_refs.append((os.path.basename(handle.path), handle.range))
+        self.notify(uid, ("segments", seq, new_refs))
 
     def _server_dir(self, uid: str) -> str:
         return os.path.join(self.data_dir, uid, "segments")

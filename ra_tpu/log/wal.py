@@ -382,8 +382,12 @@ class Wal:
             live = {u: s for u, s in seqs.items() if not s.is_empty()}
             if self.segment_writer is not None and live:
                 self.segment_writer.flush_mem_tables(live, wal_file=path)
-            else:
+            elif not live:
                 os.unlink(path)
+            # else: no segment writer configured — the file is the only
+            # durable copy of these entries (the memtable rebuild above is
+            # RAM only), so it must survive until a segment writer flushes
+            # it; recovery re-reads it next boot (idempotent inserts)
             num = int(fname.split(".")[0])
             self._file_num = max(self._file_num, num)
 

@@ -106,6 +106,11 @@ class GroupState(NamedTuple):
     # via record_appended); empty when lo > hi
     unknown_lo: jax.Array  # i32[G]
     unknown_hi: jax.Array  # i32[G]
+    # pre-vote round counter: bumped on every pre-vote entry so stale
+    # grants from an earlier round can't combine with the current one
+    # (mirrors Server.pre_vote_token; reference: token ref in
+    # src/ra_server.erl call_for_election :2900-2924)
+    pre_vote_token: jax.Array  # i32[G]
 
 
 class Mailbox(NamedTuple):
@@ -133,6 +138,8 @@ class Mailbox(NamedTuple):
     # at host_term_idx (-1 = no override)
     host_term_idx: jax.Array  # i32[G]
     host_term_val: jax.Array  # i32[G]
+    # pre-vote reply round token (must match state.pre_vote_token to count)
+    token: jax.Array  # i32[G]
 
 
 class Egress(NamedTuple):
@@ -156,6 +163,7 @@ class Egress(NamedTuple):
     role: jax.Array  # i32[G]
     leader_slot: jax.Array  # i32[G]
     agreed_idx: jax.Array  # i32[G] quorum match point (for host term lookup)
+    voted_for: jax.Array  # i32[G] post-step vote (slot or -1) for persistence
 
 
 def make_group_state(num_groups: int, num_peers: int, suffix_k: int = 32) -> GroupState:
@@ -185,6 +193,7 @@ def make_group_state(num_groups: int, num_peers: int, suffix_k: int = 32) -> Gro
         term_suffix=zi(g, k),
         unknown_lo=jnp.ones((g,), jnp.int32),
         unknown_hi=zi(g),
+        pre_vote_token=zi(g),
     )
 
 
@@ -209,6 +218,7 @@ def empty_mailbox(num_groups: int) -> Mailbox:
         cand_machine_version=zi(),
         host_term_idx=jnp.full((g,), -1, jnp.int32),
         host_term_val=jnp.full((g,), -1, jnp.int32),
+        token=zi(),
     )
 
 
@@ -382,7 +392,11 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
     )
     votes2 = jnp.where(role1[:, None] == R_CANDIDATE, votes2, False)
     count_prevote = (
-        is_prevote_reply & (role1 == R_PRE_VOTE) & mbox.success & (mbox.term <= term1)
+        is_prevote_reply
+        & (role1 == R_PRE_VOTE)
+        & mbox.success
+        & (mbox.term <= term1)
+        & (mbox.token == state.pre_vote_token)
     )
     pre_votes2 = jnp.where(
         (count_prevote[:, None] & (jnp.arange(P)[None, :] == mbox.sender_slot[:, None]))
@@ -506,6 +520,7 @@ def consensus_step_impl(state: GroupState, mbox: Mailbox) -> Tuple[GroupState, E
         role=role3,
         leader_slot=leader4,
         agreed_idx=agreed,
+        voted_for=voted3,
     )
     new_state = state._replace(
         current_term=term2,
@@ -542,13 +557,14 @@ MBOX_FIELDS = [
     "num_entries", "entries_last_term", "leader_commit", "success",
     "reply_next_idx", "reply_last_idx", "reply_last_term", "cand_last_idx",
     "cand_last_term", "cand_machine_version", "host_term_idx",
-    "host_term_val",
+    "host_term_val", "token",
 ]
 EGRESS_FIELDS = [
     "send_reply", "reply_type", "term", "success", "next_index",
     "last_index", "last_term", "aer_code", "became_leader",
     "became_candidate", "commit_advanced_to", "needs_host",
     "term_or_vote_changed", "role", "leader_slot", "agreed_idx",
+    "voted_for",
 ]
 
 
@@ -655,4 +671,12 @@ def set_roles(state: GroupState, group_ids: jax.Array, roles: jax.Array) -> Grou
     touched = jnp.zeros_like(state.role, dtype=jnp.bool_).at[group_ids].set(True)
     votes = jnp.where(touched[:, None], False, state.votes)
     pre_votes = jnp.where(touched[:, None], False, state.pre_votes)
-    return state._replace(role=role, votes=votes, pre_votes=pre_votes)
+    # entering pre-vote opens a new round: bump the token so replies from
+    # earlier rounds are ignored (the host mirrors this in
+    # GroupHost.pre_vote_token)
+    tok = state.pre_vote_token.at[group_ids].add(
+        jnp.where(roles == R_PRE_VOTE, 1, 0)
+    )
+    return state._replace(
+        role=role, votes=votes, pre_votes=pre_votes, pre_vote_token=tok
+    )

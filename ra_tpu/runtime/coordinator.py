@@ -94,7 +94,7 @@ class GroupHost:
         "machine", "machine_state", "last_applied", "role", "term",
         "leader_slot", "next_index", "commit_sent", "pending_replies",
         "inbox", "host_term_hint", "election_ref", "effective_machine_version",
-        "pending_ack", "snap_accept", "snap_senders",
+        "pending_ack", "snap_accept", "snap_senders", "pre_vote_token",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -122,6 +122,9 @@ class GroupHost:
         # inbound snapshot transfer state / outbound senders per peer
         self.snap_accept: Optional[Dict[str, Any]] = None
         self.snap_senders: Dict[ServerId, Any] = {}
+        # host mirror of the device pre-vote round token (incremented in
+        # lockstep with every set_roles(R_PRE_VOTE) scatter)
+        self.pre_vote_token = 0
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -246,6 +249,23 @@ class BatchCoordinator:
             log or MemoryLog(auto_written=True), machine,
         )
         self.groups[gid] = g
+        # restart safety: reload the durable term/vote so this member
+        # cannot re-vote in a term it already voted in
+        term0, voted_slot = 0, -1
+        if self.meta is not None:
+            uid = f"{cluster_name}_{name}"
+            term0 = int(self.meta.fetch(uid, "current_term", 0))
+            voted_sid = self.meta.fetch(uid, "voted_for", None)
+            if voted_sid is not None:
+                voted_slot = g.slot_of(tuple(voted_sid))
+                if voted_slot < 0:
+                    # we voted this term for a sid not in the current
+                    # member table (e.g. removed since): seed an
+                    # out-of-range slot so free_to_vote stays False for
+                    # the rest of the term — never degrade to "never
+                    # voted" (-1), which would allow a second grant
+                    voted_slot = self.P
+            g.term = term0
         # activate slots on device
         active = np.zeros(self.P, dtype=bool)
         active[: len(members)] = True
@@ -254,6 +274,8 @@ class BatchCoordinator:
                 active=self.state.active.at[gid].set(jnp.asarray(active)),
                 voting=self.state.voting.at[gid].set(jnp.asarray(active)),
                 self_slot=self.state.self_slot.at[gid].set(g.self_slot),
+                current_term=self.state.current_term.at[gid].set(term0),
+                voted_for=self.state.voted_for.at[gid].set(voted_slot),
             )
         self.by_name[name] = g
         return sid
@@ -467,6 +489,7 @@ class BatchCoordinator:
             p[R["msg_type"], i] = C.MSG_PREVOTE_REPLY
             p[R["term"], i] = msg.term
             p[R["success"], i] = 1 if msg.vote_granted else 0
+            p[R["token"], i] = msg.token
 
     # -- egress ------------------------------------------------------------
 
@@ -516,7 +539,12 @@ class BatchCoordinator:
             g.term = int(eg["term"][i])
             g.leader_slot = int(eg["leader_slot"][i])
             if eg["term_or_vote_changed"][i] and self.meta is not None:
-                self.meta.store_sync(f"{g.cluster_name}_{g.name}", "current_term", g.term)
+                # Raft safety: term AND vote must both be durable before
+                # any message leaves this step, or a restarted member
+                # could vote twice in one term
+                uid = f"{g.cluster_name}_{g.name}"
+                self.meta.store(uid, "current_term", g.term)
+                self.meta.store_sync(uid, "voted_for", g.sid_of(int(eg["voted_for"][i])))
             if eg["became_candidate"][i]:
                 self._hot.add(i)  # keep stepping (single-member self-election)
                 self._broadcast_vote_req(g, queue_send, pre=False)
@@ -686,7 +714,7 @@ class BatchCoordinator:
         sid = (g.name, self.name)
         if pre:
             rpc = PreVoteRpc(
-                term=g.term, token=0, candidate_id=sid, version=1,
+                term=g.term, token=g.pre_vote_token, candidate_id=sid, version=1,
                 machine_version=g.machine.version(), last_log_index=li,
                 last_log_term=lt,
             )
@@ -753,6 +781,7 @@ class BatchCoordinator:
                 jnp.asarray([C.R_PRE_VOTE], jnp.int32),
             )
             g.role = C.R_PRE_VOTE
+            g.pre_vote_token += 1
             self._hot.add(g.gid)  # force steps so the election progresses
             if len(g.members) == 1:
                 return  # the next device steps self-elect
@@ -800,6 +829,7 @@ class BatchCoordinator:
                 jnp.asarray([C.R_PRE_VOTE], jnp.int32),
             )
             g.role = C.R_PRE_VOTE
+            g.pre_vote_token += 1
             self._hot.add(g.gid)
             if len(msg) > 1 and msg[1] is not None:
                 self._reply(msg[1], ("ok", None))

@@ -8,7 +8,15 @@ src/ra_server_proc.erl:1875-1881, 2094-2110):
 - node names are ``host:port`` strings; each node runs one
   ``TcpTransport`` that accepts inbound connections and lazily dials
   outbound ones;
-- wire format: length-framed pickle of ``(to_name, from_sid, msg)``;
+- wire format: length-framed ``HMAC-SHA256(cookie) || pickle`` of
+  ``(to_name, from_sid, msg)``. Every frame is authenticated with a
+  shared-secret cookie before it is unpickled (the counterpart of the
+  Erlang distribution cookie): a frame with a bad MAC kills the
+  connection without touching pickle. **Trust model**: like the
+  reference, any peer holding the cookie is fully trusted — pickle
+  grants authenticated peers arbitrary code execution, so set a secret
+  cookie (``RA_TPU_COOKIE`` env or the ``cookie=`` arg) and run on a
+  trusted network; the built-in default cookie only keeps out strays;
 - sends are async and never block the caller: each peer has a bounded
   outbox drained by a writer thread — when the outbox overflows, sends
   report failure (the peer status flips, exactly like distribution
@@ -23,6 +31,9 @@ while local names stay in-process.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import struct
@@ -34,6 +45,7 @@ from ra_tpu.protocol import ServerId
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
+_MAC_LEN = 16  # truncated HMAC-SHA256 prefix on every frame
 
 
 class _Peer:
@@ -57,11 +69,15 @@ class TcpTransport:
         deliver,  # fn(to_sid, msg, from_sid) -> bool
         bind: Optional[Tuple[str, int]] = None,
         outbox_cap: int = 10_000,
+        cookie: Optional[str] = None,
     ):
         host, port = node_name.rsplit(":", 1)
         self.node_name = node_name
         self.deliver = deliver
         self.outbox_cap = outbox_cap
+        self._cookie = (
+            cookie or os.environ.get("RA_TPU_COOKIE") or "ra_tpu_default_cookie"
+        ).encode()
         self.blocked: set = set()
         self.drop_fn = None
         self.dropped = 0
@@ -108,7 +124,9 @@ class TcpTransport:
         from ra_tpu.protocol import sanitize_for_wire
 
         try:
-            frame = pickle.dumps((to[0], from_sid, sanitize_for_wire(msg)))
+            frame = self._seal(
+                pickle.dumps((to[0], from_sid, sanitize_for_wire(msg)))
+            )
         except Exception:  # noqa: BLE001 — unpicklable payload
             self.dropped += 1
             return False
@@ -167,6 +185,17 @@ class TcpTransport:
                 p.cv.notify_all()
 
     # ------------------------------------------------------------------
+
+    def _seal(self, payload: bytes) -> bytes:
+        mac = hmac.new(self._cookie, payload, hashlib.sha256).digest()[:_MAC_LEN]
+        return mac + payload
+
+    def _open(self, frame: bytes) -> Optional[bytes]:
+        if len(frame) < _MAC_LEN:
+            return None
+        mac, payload = frame[:_MAC_LEN], frame[_MAC_LEN:]
+        want = hmac.new(self._cookie, payload, hashlib.sha256).digest()[:_MAC_LEN]
+        return payload if hmac.compare_digest(mac, want) else None
 
     def _peer(self, node_name: str) -> Optional[_Peer]:
         with self._lock:
@@ -229,7 +258,7 @@ class TcpTransport:
         peer = self._peer(node_name)
         if peer is None:
             return
-        frame = pickle.dumps((kind, self.node_name, payload))
+        frame = self._seal(pickle.dumps((kind, self.node_name, payload)))
         with peer.cv:
             if len(peer.outbox) < peer.cap:
                 peer.outbox.append(frame)
@@ -273,8 +302,11 @@ class TcpTransport:
                         break
                     frame = buf[_LEN.size : _LEN.size + ln]
                     buf = buf[_LEN.size + ln :]
+                    payload = self._open(frame)
+                    if payload is None:
+                        return  # unauthenticated frame: drop connection
                     try:
-                        to_name, from_sid, msg = pickle.loads(frame)
+                        to_name, from_sid, msg = pickle.loads(payload)
                     except Exception:  # noqa: BLE001
                         return
                     if to_name == "__ping__":

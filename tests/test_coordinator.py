@@ -349,3 +349,43 @@ def _warm_kernel():
             c.step_once()
     finally:
         c.registry.unregister("warmup")
+
+
+def test_coordinator_reloads_term_and_vote_from_meta(tmp_path):
+    """Raft safety on restart: a batch-backed member must come back with
+    its durable current_term AND voted_for (ADVICE r1: term-only reload
+    allowed double voting in one term)."""
+    from ra_tpu.log.meta_store import FileMeta
+
+    leaderboard.clear()
+    meta = FileMeta(str(tmp_path / "meta"))
+    c = BatchCoordinator("mv1", capacity=8, num_peers=3, meta=meta)
+    c.start()
+    try:
+        sid = ("gm", "mv1")
+        c.add_group("gm", "clm", [sid], adder())
+        c.deliver(sid, ElectionTimeout(), None)
+        await_(lambda: c.by_name["gm"].role == C.R_LEADER, what="leader")
+        # self-election persisted term + self-vote
+        await_(lambda: meta.fetch("clm_gm", "current_term", 0) >= 1,
+               what="term persisted")
+        term = meta.fetch("clm_gm", "current_term", 0)
+        assert tuple(meta.fetch("clm_gm", "voted_for")) == sid
+    finally:
+        c.stop()
+
+    # restart: device state must be seeded from meta, not term 0
+    c2 = BatchCoordinator("mv1", capacity=8, num_peers=3, meta=meta)
+    try:
+        sid = ("gm", "mv1")
+        c2.add_group("gm", "clm", [sid], adder())
+        g = c2.by_name["gm"]
+        assert g.term == term
+        import numpy as np
+
+        assert int(np.asarray(c2.state.current_term)[g.gid]) == term
+        assert int(np.asarray(c2.state.voted_for)[g.gid]) == g.self_slot
+    finally:
+        c2.stop()
+        leaderboard.clear()
+        meta.close()

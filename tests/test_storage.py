@@ -506,3 +506,38 @@ def test_log_resend_protocol(tmp_path):
     wal.flush()
     feed_events(log, sink)
     assert log.last_written()[0] == 3
+
+
+def test_segment_writer_retains_wal_file_on_flush_failure(tmp_path, monkeypatch):
+    """A failed flush must NOT unlink the WAL file (the only durable copy
+    of acked entries) and must not kill future flushes (ADVICE r1)."""
+    sink = Sink()
+    tables = TableRegistry()
+    sw = SegmentWriter(str(tmp_path / "data"), tables, sink, threaded=False)
+    sw.MAX_FLUSH_ATTEMPTS = 2
+    mt = tables.mem_table("u1")
+    for i in range(1, 4):
+        mt.insert(Entry(i, 1, i))
+    wal_file = str(tmp_path / "00000001.wal")
+    with open(wal_file, "wb") as f:
+        f.write(b"RTW1fake")
+
+    calls = {"n": 0}
+    real = sw._flush_job
+
+    def boom(seqs):
+        calls["n"] += 1
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(sw, "_flush_job", boom)
+    sw.flush_mem_tables({"u1": Seq.from_list([1, 2, 3])}, wal_file=wal_file)
+    assert calls["n"] == 2  # retried, then gave up
+    assert os.path.exists(wal_file)  # durable copy retained
+    assert sw.counter.to_dict()["flush_errors"] == 2
+
+    # the writer still works after the failure
+    monkeypatch.setattr(sw, "_flush_job", real)
+    sw.flush_mem_tables({"u1": Seq.from_list([1, 2, 3])}, wal_file=wal_file)
+    assert sink.of("u1", "segments")
+    assert not os.path.exists(wal_file)
+    sw.close()

@@ -153,3 +153,70 @@ def test_multiprocess_cluster(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_tcp_rejects_unauthenticated_frames():
+    """Frames without a valid cookie MAC must be dropped before pickle
+    ever sees them (ADVICE r1: arbitrary unpickling from any peer)."""
+    import pickle
+    import struct
+    import threading
+
+    from ra_tpu.runtime.tcp import TcpTransport, _LEN
+
+    got = []
+    port = free_port()
+    t = TcpTransport(
+        f"127.0.0.1:{port}",
+        lambda to, msg, frm: got.append((to, msg)) or True,
+        cookie="secret-a",
+    )
+    try:
+        # raw attacker frame: valid pickle, no/garbage MAC
+        evil = pickle.dumps(("t0", None, ("pwn",)))
+        s = socket.create_connection(("127.0.0.1", port), timeout=2)
+        s.sendall(_LEN.pack(len(evil)) + evil)
+        time.sleep(0.3)
+        assert got == []
+        # the connection was killed: a subsequent good-looking send fails
+        # eventually (send buffer may absorb one write)
+        dead = False
+        try:
+            for _ in range(20):
+                s.sendall(_LEN.pack(len(evil)) + evil)
+                time.sleep(0.02)
+        except OSError:
+            dead = True
+        assert dead
+        s.close()
+
+        # frames sealed with the right cookie ARE delivered
+        t2 = TcpTransport(
+            f"127.0.0.1:{free_port()}",
+            lambda to, msg, frm: True,
+            cookie="secret-a",
+        )
+        try:
+            assert t2.send(("t0", f"127.0.0.1:{port}"), ("hello",), None)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not got:
+                time.sleep(0.02)
+            assert got and got[0][1] == ("hello",)
+        finally:
+            t2.close()
+
+        # ...but a transport with the WRONG cookie is rejected
+        got.clear()
+        t3 = TcpTransport(
+            f"127.0.0.1:{free_port()}",
+            lambda to, msg, frm: True,
+            cookie="wrong-cookie",
+        )
+        try:
+            t3.send(("t0", f"127.0.0.1:{port}"), ("intruder",), None)
+            time.sleep(0.3)
+            assert got == []
+        finally:
+            t3.close()
+    finally:
+        t.close()
