@@ -1,12 +1,15 @@
 """Benchmark: multi-raft throughput on the tpu_batch coordinator backend.
 
-Headline (default): end-to-end replicated commands/sec — G raft groups x
-3 replicas spread over three batch coordinators in this process, no-op
-machine (the reference ra_bench workload shape: src/ra_bench.erl),
-commands pipelined to every group leader, measured until every group has
-applied everything. This exercises the whole pipeline: host append ->
-device decision steps (AER accept / reply bookkeeping / quorum scan,
-fused over all groups) -> follower accept -> commit -> apply.
+Headline (default): end-to-end replicated commands/sec — 10,240 raft
+groups x 3 replicas spread over three batch coordinators in this
+process, no-op machine (the reference ra_bench workload shape:
+src/ra_bench.erl), commands pipelined to every group leader, measured
+until every group has applied everything. This exercises the whole
+pipeline: host append -> device decision steps (AER accept / reply
+bookkeeping / quorum scan, fused over all groups) -> follower accept ->
+commit -> apply. The coordinators are stepped cooperatively from one
+thread (same message flow as the threaded mode; on the 1-core bench
+host, thread ping-pong would only add GIL handoff latency).
 
 ``--decisions`` instead measures the raw fused decision-kernel
 throughput at 10k groups (the device ceiling, no host routing).
@@ -72,6 +75,12 @@ def _retry_on_cpu_or_fail() -> None:
 
 
 def bench_pipeline(groups: int, cmds: int) -> dict:
+    """Cooperative-scheduler pipeline bench: the three coordinators are
+    stepped round-robin from this thread (their threaded step loops are
+    never started). On a multi-core host the threaded mode adds
+    parallelism, but the driver's bench box has one core, where thread
+    ping-pong only adds GIL handoff latency; the message flow and the
+    per-step work are identical either way."""
     from ra_tpu.machine import SimpleMachine
     from ra_tpu.ops import consensus as C
     from ra_tpu.protocol import Command, ElectionTimeout, USR
@@ -81,8 +90,6 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
         BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3, idle_sleep_s=0)
         for i in range(3)
     ]
-    for c in coords:
-        c.start()
     try:
         members = lambda g: [(f"g{g}", f"bench{i}") for i in range(3)]  # noqa: E731
         for g in range(groups):
@@ -90,49 +97,73 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
                 c.add_group(
                     f"g{g}", f"cl{g}", members(g), SimpleMachine(lambda x, s: s + x, 0)
                 )
-        for g in range(groups):
-            coords[0].deliver((f"g{g}", "bench0"), ElectionTimeout(), None)
-        deadline = time.time() + 300
-        while time.time() < deadline:
-            if all(
-                coords[0].by_name[f"g{g}"].role == C.R_LEADER for g in range(groups)
-            ):
-                break
-            time.sleep(0.05)
-        else:
-            pass
-        if not all(
-            coords[0].by_name[f"g{g}"].role == C.R_LEADER for g in range(groups)
-        ):
+        coords[0].deliver_many(
+            [((f"g{g}", "bench0"), ElectionTimeout(), None) for g in range(groups)]
+        )
+
+        def step_all() -> bool:
+            worked = False
+            for c in coords:
+                worked = c.step_once() or worked
+            return worked
+
+        def all_leaders() -> bool:
+            by = coords[0].by_name
+            return all(by[f"g{g}"].role == C.R_LEADER for g in range(groups))
+
+        deadline = time.time() + 600
+        while time.time() < deadline and not all_leaders():
+            if not step_all():
+                time.sleep(0.001)
+        if not all_leaders():
             print("bench error: leader election incomplete", file=sys.stderr)
             _retry_on_cpu_or_fail()
 
-        t0 = time.perf_counter()
-        for _ in range(cmds):
-            for g in range(groups):
-                coords[0].deliver(
-                    (f"g{g}", "bench0"),
-                    Command(kind=USR, data=1, reply_mode="noreply"),
-                    None,
+        # settle all in-flight work (election noops) so the applied
+        # floor below is exact
+        while step_all():
+            pass
+        base = coords[0]._applied_np[:groups].copy()
+
+        def run_wave(n_waves: int) -> None:
+            cmd = Command(kind=USR, data=1, reply_mode="noreply")
+            for _ in range(n_waves):
+                base.__iadd__(1)
+                coords[0].deliver_many(
+                    [((f"g{g}", "bench0"), cmd, None) for g in range(groups)]
                 )
-        while time.time() < deadline:
-            if all(
-                coords[0].by_name[f"g{g}"].machine_state == cmds
-                for g in range(groups)
-            ):
-                break
-            time.sleep(0.02)
-        dt = time.perf_counter() - t0
-        if not all(
-            coords[0].by_name[f"g{g}"].machine_state == cmds for g in range(groups)
-        ):
+            while time.time() < deadline:
+                step_all()
+                if all((c._applied_np[:groups] >= base).all() for c in coords):
+                    return
+            raise TimeoutError("wave did not complete")
+
+        try:
+            run_wave(1)  # warmup: compiles remaining scatter/step shapes
+        except TimeoutError:
+            print("bench error: warmup wave incomplete", file=sys.stderr)
+            _retry_on_cpu_or_fail()
+
+        state0 = coords[0].by_name["g0"].machine_state
+        t0 = time.perf_counter()
+        try:
+            run_wave(cmds)
+        except TimeoutError:
             done = sum(
-                coords[0].by_name[f"g{g}"].machine_state == cmds
+                coords[0].by_name[f"g{g}"].machine_state - state0 == cmds
                 for g in range(groups)
             )
             print(
                 f"bench error: only {done}/{groups} groups completed", file=sys.stderr
             )
+            _retry_on_cpu_or_fail()
+        dt = time.perf_counter() - t0
+        bad = sum(
+            coords[0].by_name[f"g{g}"].machine_state - state0 != cmds
+            for g in range(groups)
+        )
+        if bad:
+            print(f"bench error: {bad}/{groups} groups wrong state", file=sys.stderr)
             _retry_on_cpu_or_fail()
         total = groups * cmds
         import jax
@@ -213,8 +244,8 @@ def main() -> None:
         g = args.groups or (1024 if args.smoke else 10240)
         out = bench_decisions(g, args.steps or (10 if args.smoke else 200))
     else:
-        g = args.groups or (128 if args.smoke else 2048)
-        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 5))
+        g = args.groups or (128 if args.smoke else 10240)
+        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 20))
     print(json.dumps(out))
 
 

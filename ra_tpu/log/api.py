@@ -65,6 +65,18 @@ class LogApi:
     def fold(self, lo: int, hi: int, fn: Callable[[Entry, Any], Any], acc: Any) -> Any:
         raise NotImplementedError
 
+    def fetch_range(self, lo: int, hi: int) -> List[Entry]:
+        """Contiguous read [lo, hi]; stops early at the first missing
+        index (hot path: AER construction and the apply loop — concrete
+        logs override with a batched implementation)."""
+        out: List[Entry] = []
+        for i in range(lo, hi + 1):
+            e = self.fetch(i)
+            if e is None:
+                break
+            out.append(e)
+        return out
+
     def sparse_read(self, idxs: Sequence[int]) -> List[Entry]:
         raise NotImplementedError
 

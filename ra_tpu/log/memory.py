@@ -157,6 +157,16 @@ class MemoryLog(LogApi):
             acc = fn(e, acc)
         return acc
 
+    def fetch_range(self, lo: int, hi: int) -> List[Entry]:
+        get = self.entries.get
+        out: List[Entry] = []
+        for i in range(lo, hi + 1):
+            e = get(i)
+            if e is None:
+                break
+            out.append(e)
+        return out
+
     def sparse_read(self, idxs: Sequence[int]) -> List[Entry]:
         return [self.entries[i] for i in idxs if i in self.entries]
 

@@ -36,6 +36,17 @@ class Machine:
 
     # -- optional ----------------------------------------------------------
 
+    # Batched apply: an ra_tpu extension beyond the reference behaviour
+    # (the per-entry ``apply`` contract is unchanged; this is the
+    # vectorization hook the batch backend uses when replies and effects
+    # are not needed for a run of entries). Return the final state after
+    # applying ``cmds`` (a list of command payloads at consecutive
+    # indexes) or None to fall back to per-entry ``apply``.
+    def apply_many(
+        self, meta: Dict[str, Any], cmds: List[Any], state: Any
+    ) -> Optional[Any]:
+        return None
+
     def state_enter(self, role: str, state: Any) -> List[Effect]:
         return []
 
@@ -105,6 +116,15 @@ class SimpleMachine(Machine):
             return state, None  # simple machines ignore builtins
         new_state = self.fn(cmd, state)
         return new_state, new_state
+
+    def apply_many(self, meta, cmds, state):
+        fn = self.fn
+        for cmd in cmds:
+            if not (isinstance(cmd, tuple) and cmd and cmd[0] in (
+                "down", "nodeup", "nodedown", "machine_version", "timeout",
+            )):
+                state = fn(cmd, state)
+        return state
 
     def overview(self, state):
         return {"type": "simple", "state": state}

@@ -629,6 +629,49 @@ def record_appended(
 
 
 @jax.jit
+def record_appended_runs(
+    state: GroupState,
+    group_ids: jax.Array,
+    los: jax.Array,
+    his: jax.Array,
+    terms: jax.Array,
+) -> GroupState:
+    """Record contiguous same-term appended runs — ONE row per group
+    instead of one per entry (steady-state leaders append whole command
+    batches in their current term). ``group_ids`` must be unique within
+    the call (pad with an out-of-range gid). Ring slots covered by
+    [lo, hi] are filled with ``term``; tails/staleness update as in
+    ``record_appended``."""
+    k = state.term_suffix.shape[-1]
+    los_c = jnp.maximum(los, his - (k - 1))
+    slots = jnp.arange(k)[None, :]
+    # largest index i <= hi with i % k == slot
+    idx_at_slot = his[:, None] - ((his[:, None] - slots) % k)
+    mask = idx_at_slot >= los_c[:, None]
+    cur = state.term_suffix.at[group_ids].get(mode="fill", fill_value=0)
+    rows = jnp.where(mask, terms[:, None], cur)
+    ts = state.term_suffix.at[group_ids].set(rows, mode="drop")
+    last_index = state.last_index.at[group_ids].max(his, mode="drop")
+    touched = (
+        jnp.zeros_like(state.last_index, dtype=jnp.bool_)
+        .at[group_ids].set(True, mode="drop")
+    )
+    ring_at_tail = jnp.take_along_axis(
+        ts, (last_index % k)[:, None], axis=-1
+    ).squeeze(-1)
+    last_term = jnp.where(touched, ring_at_tail, state.last_term)
+    unknown_lo = jnp.where(touched, 1, state.unknown_lo)
+    unknown_hi = jnp.where(touched, 0, state.unknown_hi)
+    return state._replace(
+        term_suffix=ts,
+        last_index=last_index,
+        last_term=last_term,
+        unknown_lo=unknown_lo,
+        unknown_hi=unknown_hi,
+    )
+
+
+@jax.jit
 def record_written(state: GroupState, group_ids: jax.Array, idxs: jax.Array) -> GroupState:
     """Advance durable watermarks after WAL fsync."""
     return state._replace(written_index=state.written_index.at[group_ids].max(idxs))

@@ -174,7 +174,12 @@ class BatchCoordinator:
 
         self._ingress: deque = deque()
         self._ingress_cv = threading.Condition()
-        self._pending_scatters: List[Tuple[str, int, int, int]] = []
+        # ("a", gid, lo, hi, term) appended runs | ("w", gid, idx) durable
+        self._pending_scatters: List[Tuple] = []
+        # role transitions queued by rare paths, applied as ONE scatter
+        # at the start of the next step (an election storm over many
+        # groups must not pay one jitted scatter per group)
+        self._pending_roles: List[Tuple[int, int]] = []
         self._hot: set = set()  # gids with queued inbox msgs / term hints
         self._applied_np = np.zeros(capacity, np.int64)  # last_applied mirror
         # guards self.state (donated buffers!) between the step thread and
@@ -212,6 +217,17 @@ class BatchCoordinator:
             self._ingress.append((to[0], from_sid, msg))
             self._ingress_cv.notify()
         return True
+
+    def deliver_many(self, msgs) -> None:
+        """Batch ingress: one lock round for many ``(to_sid, msg,
+        from_sid)`` triples (unknown group names are dropped, as in
+        ``deliver``)."""
+        by = self.by_name
+        with self._ingress_cv:
+            self._ingress.extend(
+                (to[0], frm, m) for to, m, frm in msgs if to[0] in by
+            )
+            self._ingress_cv.notify()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -302,32 +318,69 @@ class BatchCoordinator:
             batch = list(self._ingress)
             self._ingress.clear()
         rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = []
-        appended: List[Tuple[int, int, int]] = []  # gid, idx, term
-        written: List[Tuple[int, int]] = []
+        # appended runs: gid -> [[lo, hi, term], ...] (contiguous,
+        # same-term); written: gid -> max durable idx. Run-based so the
+        # device scatter is one row per touched GROUP, not per entry.
+        appended: Dict[int, List[List[int]]] = {}
+        written: Dict[int, int] = {}
         aer_dirty: set = set()
 
+        by_get = self.by_name.get
+        handle_cmd = self._handle_command
+        route = self._route_one
         for to_name, from_sid, msg in batch:
-            g = self.by_name.get(to_name)
+            g = by_get(to_name)
             if g is None:
                 continue
-            self._route_one(g, from_sid, msg, rare, appended, written, aer_dirty)
+            if type(msg) is Command:  # the hot ingest type
+                handle_cmd(g, msg, appended, written, aer_dirty)
+            else:
+                route(g, from_sid, msg, rare, appended, written, aer_dirty)
 
-        if not (batch or self._hot or rare or appended or written or self._pending_scatters):
+        if not (
+            batch or self._hot or rare or appended or written
+            or self._pending_scatters or self._pending_roles
+        ):
             return False
 
-        appended.extend(
-            (gid, idx, term) for kind, gid, idx, term in self._pending_scatters if kind == "a"
-        )
-        written.extend(
-            (gid, idx) for kind, gid, idx, _ in self._pending_scatters if kind == "w"
-        )
+        if self._pending_roles:
+            gids, roles, _ = self._pad3(
+                [(gid, role, 0) for gid, role in self._pending_roles]
+            )
+            self._pending_roles = []
+            self.state = C.set_roles(self.state, gids, roles)
+
+        for item in self._pending_scatters:
+            if item[0] == "a":
+                _, gid, lo, hi, term = item
+                runs = appended.setdefault(gid, [])
+                if runs and runs[-1][1] + 1 == lo and runs[-1][2] == term:
+                    runs[-1][1] = hi
+                else:
+                    runs.append([lo, hi, term])
+            else:
+                _, gid, idx = item
+                if written.get(gid, 0) < idx:
+                    written[gid] = idx
         self._pending_scatters = []
 
         if appended:
-            gids, idxs, terms = self._pad3(appended)
-            self.state = C.record_appended(self.state, gids, idxs, terms)
+            rows: List[Tuple[int, int, int, int]] = []
+            legacy: List[Tuple[int, int, int]] = []  # older runs, per entry
+            for gid, runs in appended.items():
+                for lo, hi, term in runs[:-1]:
+                    legacy.extend((gid, i, term) for i in range(lo, hi + 1))
+                lo, hi, term = runs[-1]
+                rows.append((gid, lo, hi, term))
+            if legacy:
+                # rare (mixed-term batches): scatter older runs first so
+                # the newest run's ring slots win
+                gids, idxs, terms = self._pad3(legacy)
+                self.state = C.record_appended(self.state, gids, idxs, terms)
+            gids, los, his, terms = self._pad4(rows)
+            self.state = C.record_appended_runs(self.state, gids, los, his, terms)
         if written:
-            gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written])
+            gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written.items()])
             self.state = C.record_written(self.state, gids, idxs)
 
         packed, consumed = self._build_mailbox()
@@ -343,22 +396,26 @@ class BatchCoordinator:
         self._send_aers(aer_dirty)
         return True
 
-    def _pad3(self, triples):
+    def _pad(self, rows, width: int):
         """Pad scatter batches to power-of-two buckets so XLA compiles a
         handful of shapes instead of one per batch length. Pads use an
-        out-of-bounds group id, which jitted scatters drop."""
-        n = len(triples)
+        out-of-bounds group id, which jitted scatters drop. Returns one
+        jnp column per input column."""
+        n = len(rows)
         cap = 1
         while cap < n:
             cap <<= 1
-        pad = (self.capacity, 0, 0)
-        full = list(triples) + [pad] * (cap - n)
-        arr = np.asarray(full, np.int32)
-        return (
-            jnp.asarray(arr[:, 0]),
-            jnp.asarray(arr[:, 1]),
-            jnp.asarray(arr[:, 2]),
-        )
+        arr = np.zeros((cap, width), np.int32)
+        arr[n:, 0] = self.capacity
+        if n:
+            arr[:n] = rows
+        return tuple(jnp.asarray(arr[:, c]) for c in range(width))
+
+    def _pad3(self, triples):
+        return self._pad(triples, 3)
+
+    def _pad4(self, rows):
+        return self._pad(rows, 4)
 
     # -- ingress routing ---------------------------------------------------
 
@@ -388,7 +445,8 @@ class BatchCoordinator:
             _, evt = msg
             g.log.handle_event(evt)
             wi, wt = g.log.last_written()
-            written.append((g.gid, wi))
+            if written.get(g.gid, 0) < wi:
+                written[g.gid] = wi
             aer_dirty.add(g.gid)
             if g.pending_ack is not None and wi >= g.pending_ack[1]:
                 leader_sid = g.pending_ack[0]
@@ -407,17 +465,27 @@ class BatchCoordinator:
             if cmd.from_ref is not None:
                 self._reply(cmd.from_ref, ("redirect", g.sid_of(g.leader_slot)))
             return
-        idx = g.log.next_index()
-        entry = Entry(index=idx, term=g.term, cmd=cmd)
-        g.log.append(entry)
-        appended.append((g.gid, idx, g.term))
-        wi, _ = g.log.last_written()
-        if wi >= idx:
-            written.append((g.gid, idx))
-        if cmd.reply_mode == "after_log_append" and cmd.from_ref is not None:
-            self._reply(cmd.from_ref, ("ok", (idx, g.term), (g.name, self.name)))
-        elif cmd.reply_mode == "await_consensus" and cmd.from_ref is not None:
-            g.pending_replies[idx] = cmd.from_ref
+        log = g.log
+        idx = log.next_index()
+        term = g.term
+        log.append(Entry(idx, term, cmd))
+        gid = g.gid
+        runs = appended.get(gid)
+        if runs is None:
+            appended[gid] = [[idx, idx, term]]
+        else:
+            last = runs[-1]
+            if last[1] + 1 == idx and last[2] == term:
+                last[1] = idx
+            else:
+                runs.append([idx, idx, term])
+        if log.last_written()[0] >= idx and written.get(gid, 0) < idx:
+            written[gid] = idx
+        if cmd.from_ref is not None:
+            if cmd.reply_mode == "after_log_append":
+                self._reply(cmd.from_ref, ("ok", (idx, g.term), (g.name, self.name)))
+            elif cmd.reply_mode == "await_consensus":
+                g.pending_replies[idx] = cmd.from_ref
         aer_dirty.add(g.gid)
 
     # -- mailbox build -----------------------------------------------------
@@ -499,24 +567,29 @@ class BatchCoordinator:
         def queue_send(to: ServerId, msg: Any, frm: ServerId):
             outbound.setdefault(to[1], []).append((to, msg, frm))
 
+        groups = self.groups
+        needs_host = eg["needs_host"]
+        aer_code = eg["aer_code"]
+        send_reply = eg["send_reply"]
+        term_row = eg["term"]
         for i, (from_sid, msg) in consumed.items():
-            g = self.groups[i]
+            g = groups[i]
             if g is None:
                 continue
             if isinstance(msg, AppendEntriesRpc):
-                if eg["needs_host"][i]:
+                if needs_host[i]:
                     self._host_resolve_aer(g, from_sid, msg, queue_send)
-                elif eg["aer_code"][i] == C.AER_OK:
+                elif aer_code[i] == C.AER_OK:
                     # the host performs the write and owns the durable
                     # watermark, so it builds the success ack (possibly
                     # deferred until WAL fsync)
                     self._host_write_entries(g, msg)
-                    self._ack_aer(g, from_sid, msg, int(eg["term"][i]), queue_send)
-                elif eg["send_reply"][i] and from_sid is not None:
+                    self._ack_aer(g, from_sid, msg, int(term_row[i]), queue_send)
+                elif send_reply[i] and from_sid is not None:
                     reply = self._build_reply(g, msg, eg, i)
                     if reply is not None:
                         queue_send(from_sid, reply, (g.name, self.name))
-            elif eg["send_reply"][i] and from_sid is not None:
+            elif send_reply[i] and from_sid is not None:
                 reply = self._build_reply(g, msg, eg, i)
                 if reply is not None:
                     queue_send(from_sid, reply, (g.name, self.name))
@@ -529,15 +602,21 @@ class BatchCoordinator:
             | eg["became_leader"][:n]
             | eg["term_or_vote_changed"][:n]
             | (eg["commit_advanced_to"][:n] > applied)
-            | eg["needs_host"][:n]
+            | needs_host[:n]
         )
-        for i in set(consumed) | set(interesting.tolist()):
-            g = self.groups[i]
+        role_row = eg["role"]
+        leader_row = eg["leader_slot"]
+        touched = (
+            interesting.tolist() if len(consumed) == 0
+            else set(consumed) | set(interesting.tolist())
+        )
+        for i in touched:
+            g = groups[i]
             if g is None:
                 continue
-            g.role = int(eg["role"][i])
-            g.term = int(eg["term"][i])
-            g.leader_slot = int(eg["leader_slot"][i])
+            g.role = int(role_row[i])
+            g.term = int(term_row[i])
+            g.leader_slot = int(leader_row[i])
             if eg["term_or_vote_changed"][i] and self.meta is not None:
                 # Raft safety: term AND vote must both be durable before
                 # any message leaves this step, or a restarted member
@@ -608,23 +687,35 @@ class BatchCoordinator:
         if not msg.entries:
             return
         li, _ = g.log.last_index_term()
-        to_write = []
-        for e in msg.entries:
-            if e.index <= li and g.log.fetch_term(e.index) == e.term:
-                continue
-            to_write = [x for x in msg.entries if x.index >= e.index]
-            break
-        if not to_write and msg.entries[-1].index > li:
-            to_write = [e for e in msg.entries if e.index > li]
+        if msg.entries[0].index == li + 1:
+            # fast path (steady-state pipeline): strictly-new suffix
+            to_write = list(msg.entries)
+        else:
+            to_write = []
+            for e in msg.entries:
+                if e.index <= li and g.log.fetch_term(e.index) == e.term:
+                    continue
+                to_write = [x for x in msg.entries if x.index >= e.index]
+                break
+            if not to_write and msg.entries[-1].index > li:
+                to_write = [e for e in msg.entries if e.index > li]
         if to_write:
             g.log.write(list(to_write))
             # reconcile the device term ring exactly (clears the
-            # multi-entry unknown interval next step)
-            for e in to_write:
-                self._pending_scatters.append(("a", g.gid, e.index, e.term))
+            # multi-entry unknown interval next step); contiguous
+            # same-term spans collapse to one run row
+            pend = self._pending_scatters
+            lo = prev = to_write[0].index
+            term = to_write[0].term
+            for e in to_write[1:]:
+                if e.term != term:
+                    pend.append(("a", g.gid, lo, prev, term))
+                    lo, term = e.index, e.term
+                prev = e.index
+            pend.append(("a", g.gid, lo, prev, term))
             wi, _ = g.log.last_written()
             if wi >= to_write[-1].index:
-                self._pending_scatters.append(("w", g.gid, wi, 0))
+                pend.append(("w", g.gid, wi))
 
     def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, queue_send):
         """Success ack with the host's durable watermark; deferred until
@@ -649,34 +740,71 @@ class BatchCoordinator:
         # the new term's noop (commit gate + version carrier)
         idx = g.log.next_index()
         g.log.append(Entry(index=idx, term=g.term, cmd=Command(kind=NOOP)))
-        self._pending_scatters.append(("a", g.gid, idx, g.term))
+        self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
         wi, _ = g.log.last_written()
         if wi >= idx:
-            self._pending_scatters.append(("w", g.gid, wi, 0))
+            self._pending_scatters.append(("w", g.gid, wi))
         aer_dirty.add(g.gid)
 
     def _apply_group(self, g: GroupHost, commit_index: int) -> None:
         li, _ = g.log.last_index_term()
         hi = min(commit_index, li)
-
-        def apply_one(entry: Entry, acc):
+        if hi <= g.last_applied:
+            return
+        # hot loop: locals bound once, apply-result normalization inlined
+        # (machines return (state, reply) or (state, reply, effects))
+        entries = g.log.fetch_range(g.last_applied + 1, hi)
+        if len(entries) != hi - g.last_applied:
+            # fail fast like fold(): a gap below the commit index is a
+            # log integrity violation, never something to skip silently
+            raise KeyError(
+                f"missing log entries applying ({g.last_applied}, {hi}] "
+                f"in group {g.name}: got {len(entries)}"
+            )
+        pending = g.pending_replies
+        machine = g.machine
+        mver = g.effective_machine_version
+        state = g.machine_state
+        if not pending and len(entries) > 1:
+            # no replies owed anywhere in the range: offer the machine
+            # the whole run of user payloads at once (apply_many hook)
+            cmds = [
+                e.cmd.data for e in entries
+                if isinstance(e.cmd, Command) and e.cmd.kind == USR
+            ]
+            if cmds:
+                batched = machine.apply_many(
+                    {"index": hi, "term": entries[-1].term,
+                     "machine_version": mver},
+                    cmds, state,
+                )
+                if batched is not None:
+                    g.machine_state = batched
+                    g.last_applied = hi
+                    self._applied_np[g.gid] = hi
+                    return
+            else:
+                g.last_applied = hi
+                self._applied_np[g.gid] = hi
+                return
+        apply_fn = machine.apply
+        is_leader = g.role == C.R_LEADER
+        for entry in entries:
             cmd = entry.cmd
             if isinstance(cmd, Command) and cmd.kind == USR:
-                meta = {"index": entry.index, "term": entry.term,
-                        "machine_version": g.effective_machine_version}
-                state, reply, _effs = normalize_apply_result(
-                    g.machine.apply(meta, cmd.data, g.machine_state)
+                res = apply_fn(
+                    {"index": entry.index, "term": entry.term,
+                     "machine_version": mver},
+                    cmd.data, state,
                 )
-                g.machine_state = state
-                fut = g.pending_replies.pop(entry.index, None)
-                if fut is not None and g.role == C.R_LEADER:
-                    self._reply(fut, ("ok", reply, (g.name, self.name)))
-            return acc
-
-        if hi > g.last_applied:
-            g.log.fold(g.last_applied + 1, hi, apply_one, None)
-            g.last_applied = hi
-            self._applied_np[g.gid] = hi
+                state = res[0]
+                if pending:
+                    fut = pending.pop(entry.index, None)
+                    if fut is not None and is_leader:
+                        self._reply(fut, ("ok", res[1], (g.name, self.name)))
+        g.machine_state = state
+        g.last_applied = hi
+        self._applied_np[g.gid] = hi
 
     # -- outbound ----------------------------------------------------------
 
@@ -741,12 +869,9 @@ class BatchCoordinator:
                 nxt = g.next_index[s]
                 entries: List[Entry] = []
                 if nxt <= li:
-                    hi = min(li, nxt + self.aer_batch_size - 1)
-                    for idx in range(nxt, hi + 1):
-                        e = g.log.fetch(idx)
-                        if e is None:
-                            break
-                        entries.append(e)
+                    entries = g.log.fetch_range(
+                        nxt, min(li, nxt + self.aer_batch_size - 1)
+                    )
                 elif commit <= g.commit_sent[s]:
                     continue  # nothing new to say
                 prev_idx = nxt - 1
@@ -774,12 +899,9 @@ class BatchCoordinator:
         if isinstance(msg, ElectionTimeout):
             if g.role == C.R_LEADER:
                 return
-            # start pre-vote host-side: scatter the role, broadcast the rpc
-            self.state = C.set_roles(
-                self.state,
-                jnp.asarray([g.gid], jnp.int32),
-                jnp.asarray([C.R_PRE_VOTE], jnp.int32),
-            )
+            # start pre-vote host-side: queue the role scatter (batched
+            # across groups at the next step), broadcast the rpc
+            self._pending_roles.append((g.gid, C.R_PRE_VOTE))
             g.role = C.R_PRE_VOTE
             g.pre_vote_token += 1
             self._hot.add(g.gid)  # force steps so the election progresses
@@ -811,7 +933,7 @@ class BatchCoordinator:
             idx = g.log.next_index()
             g.log.append(Entry(index=idx, term=g.term, cmd=Command(
                 kind="ra_cluster_change", data=("replace", ((me, "voter"),)))))
-            self._pending_scatters.append(("a", g.gid, idx, g.term))
+            self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
             g.members = [me]
             g.self_slot = 0
             g.next_index = [idx + 1]
