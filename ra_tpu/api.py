@@ -220,8 +220,14 @@ def _cluster_of(sid: ServerId) -> Optional[str]:
     node = node_registry().get(sid[1])
     if node is None:
         return None
-    uid = node.directory.uid_of(sid[0])
-    return node.directory.cluster_of(uid) if uid else None
+    d = getattr(node, "directory", None)
+    if d is None:
+        # batch coordinators have no directory; groups carry their
+        # cluster name directly
+        g = getattr(node, "by_name", {}).get(sid[0])
+        return getattr(g, "cluster_name", None)
+    uid = d.uid_of(sid[0])
+    return d.cluster_of(uid) if uid else None
 
 
 def pipeline_command(

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ra_tpu import effects as fx
 from ra_tpu import leaderboard
 from ra_tpu.log.api import LogApi
 from ra_tpu.log.memory import MemoryLog
@@ -63,12 +64,17 @@ from ra_tpu.protocol import (
     ElectionTimeout,
     Entry,
     FromPeer,
+    HeartbeatReply,
+    HeartbeatRpc,
     InstallSnapshotAck,
     InstallSnapshotResult,
     InstallSnapshotRpc,
     NOOP,
     PreVoteResult,
     PreVoteRpc,
+    RA_CLUSTER_CHANGE,
+    RA_JOIN,
+    RA_LEAVE,
     RequestVoteResult,
     RequestVoteRpc,
     ServerId,
@@ -95,6 +101,9 @@ class GroupHost:
         "leader_slot", "next_index", "commit_sent", "pending_replies",
         "inbox", "host_term_hint", "election_ref", "effective_machine_version",
         "pending_ack", "snap_accept", "snap_senders", "pre_vote_token",
+        "voter_status", "cluster_change_permitted", "cluster_index",
+        "pending_queries", "machine_timers", "has_tick", "snap_floor",
+        "noop_index", "noop_committed", "query_seq",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -125,6 +134,27 @@ class GroupHost:
         # host mirror of the device pre-vote round token (incremented in
         # lockstep with every set_roles(R_PRE_VOTE) scatter)
         self.pre_vote_token = 0
+        # membership: voter status per slot ("voter" | ("nonvoter", tgt));
+        # tombstoned slots hold None in self.members. One cluster change
+        # in flight at a time (Raft one-at-a-time rule).
+        self.voter_status: Dict[int, Any] = {
+            i: "voter" for i in range(len(self.members))
+        }
+        self.cluster_change_permitted = True
+        self.cluster_index = 0  # log index of the latest cluster change
+        # consistent queries awaiting a leadership-confirmation quorum:
+        # [{"qi": idx, "fn": fn, "fut": fut, "acks": set()}]
+        self.pending_queries: List[Dict[str, Any]] = []
+        self.machine_timers: Dict[Any, Any] = {}
+        self.has_tick = type(machine).tick is not Machine.tick
+        self.snap_floor = 0  # device-known snapshot floor (host mirror)
+        # current-term-commit gate: a new leader may neither change
+        # membership nor serve linearizable reads until its own noop has
+        # committed (Raft read-index rule; reference: post_election
+        # noop + cluster_change_permitted, src/ra_server.erl:4028-4064)
+        self.noop_index = 0
+        self.noop_committed = True  # groups start pre-election
+        self.query_seq = 0
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -153,6 +183,8 @@ class BatchCoordinator:
         detector_poll_s: float = 0.1,
         meta=None,
         idle_sleep_s: float = 0.0005,
+        tick_interval_s: float = 1.0,
+        send_msg_cb=None,
     ):
         self.name = node_name
         self.capacity = capacity
@@ -161,6 +193,8 @@ class BatchCoordinator:
         self.election_timeout_s = election_timeout_s
         self.meta = meta
         self.idle_sleep_s = idle_sleep_s
+        self.tick_interval_s = tick_interval_s
+        self.send_msg_cb = send_msg_cb
 
         self.state = C.make_group_state(capacity, num_peers, suffix_k)
         # groups not yet registered must never act: mark inactive
@@ -241,6 +275,11 @@ class BatchCoordinator:
         self.running = False
         if self._started:
             self._step_thread.join(timeout=5)
+        for g in self.groups:
+            if g is not None:
+                for t in g.machine_timers.values():
+                    t.cancel()
+                g.machine_timers.clear()
         self.registry.unregister(self.name)
 
     def add_group(
@@ -431,6 +470,22 @@ class BatchCoordinator:
                 if slot >= 0:
                     if msg.success:
                         g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
+                        vs = g.voter_status.get(slot)
+                        if (
+                            isinstance(vs, tuple)
+                            and vs[0] == "nonvoter"
+                            and msg.last_index >= vs[1]
+                            and g.cluster_change_permitted
+                        ):
+                            # caught-up nonvoter: promote via a cluster
+                            # change (reference: maybe_promote_peer,
+                            # src/ra_server.erl:3977-3995)
+                            self._handle_command(
+                                g,
+                                Command(kind=RA_CLUSTER_CHANGE,
+                                        data=((from_sid, "voter"),)),
+                                appended, written, aer_dirty,
+                            )
                     else:
                         hint = max(1, min(msg.next_index, msg.last_index + 1))
                         g.next_index[slot] = min(g.next_index[slot], hint)
@@ -465,6 +520,9 @@ class BatchCoordinator:
             if cmd.from_ref is not None:
                 self._reply(cmd.from_ref, ("redirect", g.sid_of(g.leader_slot)))
             return
+        if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+            if not self._prepare_cluster_cmd(g, cmd):
+                return
         log = g.log
         idx = log.next_index()
         term = g.term
@@ -487,6 +545,124 @@ class BatchCoordinator:
             elif cmd.reply_mode == "await_consensus":
                 g.pending_replies[idx] = cmd.from_ref
         aer_dirty.add(g.gid)
+
+    # -- membership (reference: $ra_join/$ra_leave handling,
+    # src/ra_server.erl:3491-3542; one change in flight at a time) --------
+
+    def _prepare_cluster_cmd(self, g: GroupHost, cmd: Command) -> bool:
+        """Leader-side cluster change: apply to the host member table
+        immediately (Raft new-config-on-append rule), gate one change at
+        a time. Returns False when rejected (caller must not append)."""
+        if not g.cluster_change_permitted:
+            if cmd.from_ref is not None:
+                self._reply(cmd.from_ref, ("error", "cluster_change_not_permitted"))
+            return False
+        if cmd.kind == RA_JOIN:
+            member, voter = cmd.data
+            member = tuple(member)
+            if member in g.members:
+                if cmd.from_ref is not None:
+                    self._reply(cmd.from_ref, ("ok", "already_member"))
+                return False
+            slot = self._alloc_slot(g)
+            if slot is None:
+                if cmd.from_ref is not None:
+                    self._reply(cmd.from_ref, ("error", "group_at_peer_capacity"))
+                return False
+            li = g.log.last_index_term()[0]
+            g.members[slot] = member
+            g.voter_status[slot] = "voter" if voter else ("nonvoter", li)
+            g.next_index[slot] = li + 1
+            g.commit_sent[slot] = 0
+        elif cmd.kind == RA_LEAVE:
+            member = tuple(cmd.data)
+            slot = g.slot_of(member)
+            if slot < 0:
+                if cmd.from_ref is not None:
+                    self._reply(cmd.from_ref, ("ok", "not_member"))
+                return False
+            g.members[slot] = None
+            g.voter_status[slot] = None
+        else:  # RA_CLUSTER_CHANGE: explicit voter-status updates
+            for member, vs in cmd.data:
+                slot = g.slot_of(tuple(member))
+                if slot >= 0:
+                    g.voter_status[slot] = vs
+        g.cluster_change_permitted = False
+        g.cluster_index = g.log.next_index()
+        self._sync_member_rows(g)
+        return True
+
+    def _alloc_slot(self, g: GroupHost) -> Optional[int]:
+        for i, m in enumerate(g.members):
+            if m is None:
+                return i  # reuse a tombstoned slot
+        if len(g.members) < self.P:
+            g.members.append(None)
+            g.next_index.append(1)
+            g.commit_sent.append(0)
+            return len(g.members) - 1
+        return None
+
+    def _sync_member_rows(self, g: GroupHost) -> None:
+        """Scatter the host member table's active/voting view to the
+        device (call sites all run under the state lock)."""
+        active = np.zeros(self.P, dtype=bool)
+        voting = np.zeros(self.P, dtype=bool)
+        for i, m in enumerate(g.members):
+            if m is not None:
+                active[i] = True
+                voting[i] = g.voter_status.get(i) == "voter"
+        self.state = self.state._replace(
+            active=self.state.active.at[g.gid].set(jnp.asarray(active)),
+            voting=self.state.voting.at[g.gid].set(jnp.asarray(voting)),
+        )
+
+    def _adopt_cluster_cmd(self, g: GroupHost, cmd: Command, entry_index: int = 0) -> None:
+        """Follower-side adoption of a replicated cluster change (slot
+        coordinates are node-local; only the member set must agree)."""
+        if cmd.kind == RA_JOIN:
+            member, voter = cmd.data
+            member = tuple(member)
+            slot = g.slot_of(member)
+            if slot < 0:
+                slot = self._alloc_slot(g)
+                if slot is not None:
+                    g.members[slot] = member
+            if slot is not None and slot >= 0:
+                # also covers the joining member itself learning its own
+                # (non)voter status from the replicated entry; the join
+                # entry's index is the catch-up target should this node
+                # lead later (never 0 — that would promote a lagging
+                # learner on its first ack)
+                g.voter_status[slot] = (
+                    "voter" if voter else ("nonvoter", entry_index)
+                )
+        elif cmd.kind == RA_LEAVE:
+            slot = g.slot_of(tuple(cmd.data))
+            if slot >= 0:
+                g.members[slot] = None
+                g.voter_status[slot] = None
+        else:
+            if cmd.data and cmd.data[0] == "replace":
+                # force-shrink style replacement
+                new = [tuple(m) for m, _vs in cmd.data[1]]
+                me = (g.name, self.name)
+                if me in new:
+                    g.members = list(new)
+                    g.self_slot = new.index(me)
+                    g.voter_status = {i: "voter" for i in range(len(new))}
+                    g.next_index = [1] * len(new)
+                    g.commit_sent = [0] * len(new)
+                    self.state = self.state._replace(
+                        self_slot=self.state.self_slot.at[g.gid].set(g.self_slot)
+                    )
+            else:
+                for member, vs in cmd.data:
+                    slot = g.slot_of(tuple(member))
+                    if slot >= 0:
+                        g.voter_status[slot] = vs
+        self._sync_member_rows(g)
 
     # -- mailbox build -----------------------------------------------------
 
@@ -614,7 +790,18 @@ class BatchCoordinator:
             g = groups[i]
             if g is None:
                 continue
-            g.role = int(role_row[i])
+            new_role = int(role_row[i])
+            if (
+                g.pending_queries
+                and g.role == C.R_LEADER
+                and new_role != C.R_LEADER
+            ):
+                # deposed: in-flight linearizable reads must not be
+                # answered from this replica's state
+                for q in g.pending_queries:
+                    self._reply(q["fut"], ("redirect", None))
+                g.pending_queries = []
+            g.role = new_role
             g.term = int(term_row[i])
             g.leader_slot = int(leader_row[i])
             if eg["term_or_vote_changed"][i] and self.meta is not None:
@@ -701,6 +888,15 @@ class BatchCoordinator:
                 to_write = [e for e in msg.entries if e.index > li]
         if to_write:
             g.log.write(list(to_write))
+            # followers adopt replicated cluster changes at write time
+            # (reference: cluster scan on follower writes,
+            # src/ra_server.erl:1005-1040)
+            for e in to_write:
+                c = e.cmd
+                if isinstance(c, Command) and c.kind in (
+                    RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE,
+                ):
+                    self._adopt_cluster_cmd(g, c, e.index)
             # reconcile the device term ring exactly (clears the
             # multi-entry unknown interval next step); contiguous
             # same-term spans collapse to one run row
@@ -740,6 +936,9 @@ class BatchCoordinator:
         # the new term's noop (commit gate + version carrier)
         idx = g.log.next_index()
         g.log.append(Entry(index=idx, term=g.term, cmd=Command(kind=NOOP)))
+        g.noop_index = idx
+        g.noop_committed = False
+        g.cluster_change_permitted = False
         self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
         wi, _ = g.log.last_written()
         if wi >= idx:
@@ -765,15 +964,17 @@ class BatchCoordinator:
         machine = g.machine
         mver = g.effective_machine_version
         state = g.machine_state
-        if not pending and len(entries) > 1:
-            # no replies owed anywhere in the range: offer the machine
-            # the whole run of user payloads at once (apply_many hook)
-            cmds = [
-                e.cmd.data for e in entries
-                if isinstance(e.cmd, Command) and e.cmd.kind == USR
-            ]
+        if not pending and len(entries) > 1 and all(
+            type(e.cmd) is Command
+            and (e.cmd.kind == USR
+                 or (e.cmd.kind == NOOP and e.cmd.machine_version <= mver))
+            for e in entries
+        ):
+            # plain user-command run with no replies owed: offer the
+            # machine the whole payload batch at once (apply_many hook)
+            cmds = [e.cmd.data for e in entries if e.cmd.kind == USR]
             if cmds:
-                batched = machine.apply_many(
+                batched = machine.which_module(mver).apply_many(
                     {"index": hi, "term": entries[-1].term,
                      "machine_version": mver},
                     cmds, state,
@@ -787,24 +988,153 @@ class BatchCoordinator:
                 g.last_applied = hi
                 self._applied_np[g.gid] = hi
                 return
-        apply_fn = machine.apply
         is_leader = g.role == C.R_LEADER
+        mac = machine.which_module(mver)
+        apply_fn = mac.apply
+        me = (g.name, self.name)
         for entry in entries:
             cmd = entry.cmd
-            if isinstance(cmd, Command) and cmd.kind == USR:
+            if not isinstance(cmd, Command):
+                continue
+            kind = cmd.kind
+            if kind == USR:
                 res = apply_fn(
                     {"index": entry.index, "term": entry.term,
                      "machine_version": mver},
                     cmd.data, state,
                 )
                 state = res[0]
+                if len(res) > 2 and res[2]:
+                    g.machine_state = state  # effects may read/snapshot it
+                    self._realise_effects(g, res[2], is_leader)
                 if pending:
                     fut = pending.pop(entry.index, None)
                     if fut is not None and is_leader:
-                        self._reply(fut, ("ok", res[1], (g.name, self.name)))
+                        self._reply(fut, ("ok", res[1], me))
+                continue
+            if kind == NOOP:
+                if cmd.machine_version > g.effective_machine_version:
+                    # machine-version bump rides the term noop
+                    # (reference: src/ra_server.erl:3357-3417)
+                    old_v = g.effective_machine_version
+                    g.effective_machine_version = mver = cmd.machine_version
+                    mac = machine.which_module(mver)
+                    apply_fn = mac.apply
+                    res = apply_fn(
+                        {"index": entry.index, "term": entry.term,
+                         "machine_version": mver},
+                        ("machine_version", old_v, mver), state,
+                    )
+                    state = res[0]
+                if is_leader and entry.index >= g.noop_index:
+                    # the new leader's own entry committed: unlock
+                    # membership changes and linearizable reads
+                    g.noop_committed = True
+                    if entry.index >= g.cluster_index:
+                        g.cluster_change_permitted = True
+            elif kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                if entry.index >= g.cluster_index:
+                    # change committed: the next one may proceed
+                    g.cluster_change_permitted = is_leader and g.noop_committed
+            if pending and is_leader:
+                fut = pending.pop(entry.index, None)
+                if fut is not None:
+                    self._reply(fut, ("ok", None, me))
         g.machine_state = state
         g.last_applied = hi
         self._applied_np[g.gid] = hi
+
+    # -- machine effects (batch-backend executor; reference vocabulary:
+    # src/ra_machine.erl:131-159, realised per src/ra_server_proc.erl
+    # handle_effects) -----------------------------------------------------
+
+    def _realise_effects(self, g: GroupHost, effs, is_leader: bool = True) -> None:
+        """Machine effects. Log effects (release_cursor / checkpoint)
+        are realised on EVERY replica — followers must truncate too;
+        the rest (send_msg, mod_call, timer, log read, reply) are
+        leader-only. Monitor/demonitor and aux need the actor runtime —
+        groups using them should run on the per_group_actor backend."""
+        for eff in effs:
+            if not is_leader and not isinstance(
+                eff, (fx.ReleaseCursor, fx.Checkpoint)
+            ):
+                continue
+            if isinstance(eff, fx.ReleaseCursor):
+                mac = g.machine.which_module(g.effective_machine_version)
+                g.log.update_release_cursor(
+                    eff.index,
+                    tuple(m for m in g.members if m is not None),
+                    g.effective_machine_version,
+                    eff.machine_state,
+                    live_indexes=tuple(mac.live_indexes(eff.machine_state)),
+                )
+                self._sync_snapshot_floor(g)
+            elif isinstance(eff, fx.Checkpoint):
+                mac = g.machine.which_module(g.effective_machine_version)
+                g.log.checkpoint(
+                    eff.index,
+                    tuple(m for m in g.members if m is not None),
+                    g.effective_machine_version,
+                    eff.machine_state,
+                    live_indexes=tuple(mac.live_indexes(eff.machine_state)),
+                )
+            elif isinstance(eff, fx.SendMsg):
+                cb = self.send_msg_cb
+                if cb is not None:
+                    try:
+                        cb(eff.to, eff.msg, eff.options)
+                    except Exception:  # noqa: BLE001
+                        pass
+                elif callable(getattr(eff.to, "set_result", None)) or callable(eff.to):
+                    self._reply(eff.to, eff.msg)
+                elif isinstance(eff.to, tuple) and len(eff.to) == 2:
+                    self.transport.send(eff.to, eff.msg, from_sid=(g.name, self.name))
+            elif isinstance(eff, fx.ModCall):
+                try:
+                    eff.fn(*eff.args)
+                except Exception:  # noqa: BLE001
+                    pass
+            elif isinstance(eff, fx.Timer):
+                self._machine_timer(g, eff)
+            elif isinstance(eff, fx.LogRead):
+                entries = g.log.sparse_read(list(eff.indexes))
+                out = eff.fn(entries)
+                if out is not None:
+                    self.deliver((g.name, self.name), out, None)
+            elif isinstance(eff, fx.Reply):
+                self._reply(eff.from_ref, eff.reply)
+
+    def _sync_snapshot_floor(self, g: GroupHost) -> None:
+        snap = g.log.snapshot_index_term()
+        if snap is not None and snap[0] > g.snap_floor:
+            g.snap_floor = snap[0]
+            gid = jnp.asarray([g.gid], jnp.int32)
+            self.state = C.record_snapshot(
+                self.state, gid,
+                jnp.asarray([snap[0]], jnp.int32),
+                jnp.asarray([snap[1]], jnp.int32),
+            )
+
+    def _machine_timer(self, g: GroupHost, eff: fx.Timer) -> None:
+        old = g.machine_timers.pop(eff.name, None)
+        if old is not None:
+            old.cancel()
+        if eff.ms is None:
+            return
+
+        def fire():
+            g.machine_timers.pop(eff.name, None)
+            if self.running and g.role == C.R_LEADER:
+                self.deliver(
+                    (g.name, self.name),
+                    Command(kind=USR, data=("timeout", eff.name)),
+                    None,
+                )
+
+        t = threading.Timer(eff.ms / 1000.0, fire)
+        t.daemon = True
+        t.start()
+        g.machine_timers[eff.name] = t
 
     # -- outbound ----------------------------------------------------------
 
@@ -851,7 +1181,7 @@ class BatchCoordinator:
                 term=g.term, candidate_id=sid, last_log_index=li, last_log_term=lt
             )
         for s, member in enumerate(g.members):
-            if s != g.self_slot:
+            if s != g.self_slot and member is not None:
                 queue_send(member, rpc, sid)
 
     def _send_aers(self, aer_dirty) -> None:
@@ -864,7 +1194,7 @@ class BatchCoordinator:
             commit = g.last_applied  # host mirror of commit (applied == committed here)
             sid = (g.name, self.name)
             for s, member in enumerate(g.members):
-                if s == g.self_slot:
+                if s == g.self_slot or member is None:
                     continue
                 nxt = g.next_index[s]
                 entries: List[Entry] = []
@@ -899,6 +1229,8 @@ class BatchCoordinator:
         if isinstance(msg, ElectionTimeout):
             if g.role == C.R_LEADER:
                 return
+            if g.voter_status.get(g.self_slot) != "voter":
+                return  # nonvoters never start elections
             # start pre-vote host-side: queue the role scatter (batched
             # across groups at the next step), broadcast the rpc
             self._pending_roles.append((g.gid, C.R_PRE_VOTE))
@@ -920,6 +1252,28 @@ class BatchCoordinator:
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "machine_tick":
+            effs = g.machine.tick(msg[1], g.machine_state)
+            if effs and g.role == C.R_LEADER:
+                self._realise_effects(g, effs)
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "consistent_query":
+            self._handle_consistent_query(g, msg[1], msg[2])
+            return
+        if isinstance(msg, HeartbeatRpc):
+            # follower side of the query-index leadership confirmation
+            if from_sid is not None:
+                if msg.term >= g.term:
+                    reply = HeartbeatReply(term=msg.term, query_index=msg.query_index)
+                else:
+                    reply = HeartbeatReply(term=g.term, query_index=-1)
+                self._send_batch(
+                    from_sid[1], [(from_sid, reply, (g.name, self.name))]
+                )
+            return
+        if isinstance(msg, HeartbeatReply):
+            self._handle_heartbeat_reply(g, msg, from_sid)
+            return
         if isinstance(msg, tuple) and msg and msg[0] == "state_query":
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
@@ -938,6 +1292,8 @@ class BatchCoordinator:
             g.self_slot = 0
             g.next_index = [idx + 1]
             g.commit_sent = [0]
+            g.voter_status = {0: "voter"}
+            g.cluster_change_permitted = True
             onehot = np.zeros(self.P, dtype=bool)
             onehot[0] = True
             self.state = self.state._replace(
@@ -982,6 +1338,70 @@ class BatchCoordinator:
                     # resume pipelining the post-snapshot tail right away
                     self._send_aers({g.gid})
             return
+
+    def _voter_count(self, g: GroupHost) -> int:
+        return sum(
+            1 for i, m in enumerate(g.members)
+            if m is not None and g.voter_status.get(i) == "voter"
+        )
+
+    def _handle_consistent_query(self, g: GroupHost, fn, fut) -> None:
+        """Linearizable read: confirm leadership with a voter heartbeat
+        quorum round before answering, gated on the leader's own noop
+        having committed (Raft read-index; reference: query_index
+        heartbeat protocol, src/ra_server.erl consistent queries)."""
+        if g.role != C.R_LEADER:
+            self._reply(fut, ("redirect", g.sid_of(g.leader_slot)))
+            return
+        if not g.noop_committed:
+            # a fresh leader may hold committed-but-unapplied entries
+            # from the previous term; ask the caller to retry
+            self._reply(fut, ("redirect", None))
+            return
+        me = (g.name, self.name)
+        if self._voter_count(g) <= 1:
+            self._reply(fut, ("ok", fn(g.machine_state), me))
+            return
+        now = time.monotonic()
+        g.pending_queries = [
+            q for q in g.pending_queries if now - q["t"] < 10.0
+        ]
+        g.query_seq += 1
+        qid = g.query_seq
+        g.pending_queries.append(
+            {"qi": g.last_applied, "qid": qid, "fn": fn, "fut": fut,
+             "acks": set(), "t": now}
+        )
+        hb = HeartbeatRpc(term=g.term, leader_id=me, query_index=qid)
+        outbound: Dict[str, List] = {}
+        for s, member in enumerate(g.members):
+            if (
+                member is None
+                or s == g.self_slot
+                or g.voter_status.get(s) != "voter"
+            ):
+                continue  # only voter acks may confirm leadership
+            outbound.setdefault(member[1], []).append((member, hb, me))
+        for node_name, msgs in outbound.items():
+            self._send_batch(node_name, msgs)
+
+    def _handle_heartbeat_reply(self, g: GroupHost, msg: HeartbeatReply, from_sid) -> None:
+        if g.role != C.R_LEADER or from_sid is None or msg.term != g.term:
+            return
+        slot = g.slot_of(from_sid)
+        if slot < 0 or g.voter_status.get(slot) != "voter":
+            return
+        quorum = self._voter_count(g) // 2 + 1
+        me = (g.name, self.name)
+        done = []
+        for q in g.pending_queries:
+            if msg.query_index >= q["qid"]:
+                q["acks"].add(from_sid)
+                if len(q["acks"]) + 1 >= quorum and g.last_applied >= q["qi"]:
+                    self._reply(q["fut"], ("ok", q["fn"](g.machine_state), me))
+                    done.append(q)
+        for q in done:
+            g.pending_queries.remove(q)
 
     # -- snapshot transfer (batch-backed groups) ---------------------------
 
@@ -1032,6 +1452,21 @@ class BatchCoordinator:
         g.machine_state = state_obj
         g.effective_machine_version = meta.machine_version
         g.last_applied = max(g.last_applied, meta.index)
+        g.snap_floor = max(g.snap_floor, meta.index)
+        # adopt the snapshot's member set (node-local slot coordinates)
+        if meta.cluster:
+            new = [tuple(m) for m in meta.cluster]
+            me = (g.name, self.name)
+            if me in new and set(new) != {m for m in g.members if m is not None}:
+                g.members = list(new)
+                g.self_slot = new.index(me)
+                g.voter_status = {i: "voter" for i in range(len(new))}
+                g.next_index = [meta.index + 1] * len(new)
+                g.commit_sent = [0] * len(new)
+                self.state = self.state._replace(
+                    self_slot=self.state.self_slot.at[g.gid].set(g.self_slot)
+                )
+                self._sync_member_rows(g)
         self._applied_np[g.gid] = g.last_applied
         g.term = max(g.term, msg.term)
         g.leader_slot = g.slot_of(msg.leader_id)
@@ -1092,8 +1527,17 @@ class BatchCoordinator:
 
     def _detect_loop(self) -> None:
         cooldown: Dict[int, float] = {}
+        last_tick = time.monotonic()
         while self.running:
             try:
+                now0 = time.monotonic()
+                if now0 - last_tick >= self.tick_interval_s:
+                    last_tick = now0
+                    ms = int(time.time() * 1000)
+                    for i in range(self.n_groups):
+                        g = self.groups[i]
+                        if g is not None and g.has_tick:
+                            self.deliver((g.name, self.name), ("machine_tick", ms), None)
                 # a stopped node unregisters: include previously-seen
                 # names so disappearance reads as death
                 known = set(self.registry.names()) | set(self._node_status)

@@ -1,0 +1,283 @@
+"""Batch-backend capability parity (VERDICT r1 item 3).
+
+The tpu_batch coordinator must offer the same capability surface as the
+per_group_actor backend (reference: one capability surface for every
+server, src/ra.erl:343-383): machine effects (release_cursor ->
+snapshot), membership change with nonvoter catch-up promotion,
+consistent queries, machine tick/timer effects, and operation over the
+real WAL-backed log.
+"""
+
+import os
+import time
+
+import pytest
+
+from ra_tpu import api, effects as fx, leaderboard
+from ra_tpu.log.log import Log
+from ra_tpu.log.segment_writer import SegmentWriter
+from ra_tpu.log.tables import TableRegistry
+from ra_tpu.log.wal import Wal
+from ra_tpu.machine import Machine, SimpleMachine
+from ra_tpu.ops import consensus as C
+from ra_tpu.protocol import Command, ElectionTimeout, USR
+from ra_tpu.runtime.coordinator import BatchCoordinator
+
+
+def await_(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def adder():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+class SnapEveryN(Machine):
+    """Counts; emits release_cursor every N applies (ra_bench-style)."""
+
+    def __init__(self, n=5):
+        self.n = n
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        state = state + cmd
+        if meta["index"] % self.n == 0:
+            return state, state, [fx.ReleaseCursor(meta["index"], state)]
+        return state, state, []
+
+
+class TickMachine(Machine):
+    def init(self, config):
+        return {"n": 0, "ticks": 0, "timeouts": 0}
+
+    def apply(self, meta, cmd, state):
+        if isinstance(cmd, tuple) and cmd and cmd[0] == "timeout":
+            state = dict(state, timeouts=state["timeouts"] + 1)
+            return state, None, []
+        state = dict(state, n=state["n"] + cmd)
+        return state, state["n"], [fx.Timer("t1", 30)]
+
+    def tick(self, time_ms, state):
+        state["ticks"] += 1  # host-side mutation is fine for this test
+        return []
+
+
+def mk_cluster(prefix, n=3, machine=adder, groups=1, meta=None, **kw):
+    leaderboard.clear()
+    coords = {
+        i: BatchCoordinator(f"{prefix}{i}", capacity=16, num_peers=3,
+                            meta=meta, **kw)
+        for i in range(n)
+    }
+    for c in coords.values():
+        c.start()
+    members = lambda g: [(f"{prefix}g{g}", f"{prefix}{i}") for i in range(n)]  # noqa: E731
+    for g in range(groups):
+        for c in coords.values():
+            c.add_group(f"{prefix}g{g}", f"{prefix}cl{g}", members(g), machine())
+    for g in range(groups):
+        coords[0].deliver((f"{prefix}g{g}", f"{prefix}0"), ElectionTimeout(), None)
+    await_(
+        lambda: all(
+            coords[0].by_name[f"{prefix}g{g}"].role == C.R_LEADER
+            for g in range(groups)
+        ),
+        what="election",
+    )
+    return coords
+
+
+def stop_all(coords):
+    for c in coords.values():
+        c.stop()
+    leaderboard.clear()
+
+
+def test_release_cursor_effect_snapshots_batch_group():
+    coords = mk_cluster("rc", machine=lambda: SnapEveryN(5))
+    try:
+        sid = ("rcg0", "rc0")
+        for i in range(12):
+            r, _ = api.process_command(sid, 1, timeout=20)
+        g = coords[0].by_name["rcg0"]
+        # release_cursor realised against the log: snapshot floor advanced
+        await_(lambda: g.log.snapshot_index_term() is not None,
+               what="snapshot installed")
+        snap = g.log.snapshot_index_term()
+        assert snap[0] >= 5
+        # device knows the floor too (read under the state lock: the
+        # step thread donates these buffers)
+        import numpy as np
+
+        with coords[0]._state_lock:
+            dev_floor = int(np.asarray(coords[0].state.snapshot_index)[g.gid])
+        assert dev_floor == snap[0]
+        # entries at/below the floor are gone from the log
+        assert g.log.fetch(1) is None
+    finally:
+        stop_all(coords)
+
+
+def test_batch_membership_add_remove_and_promote():
+    coords = mk_cluster("mb", n=3)
+    try:
+        sid = ("mbg0", "mb0")
+        # start a 4th coordinator and join its member as a nonvoter
+        c3 = BatchCoordinator("mb3", capacity=16, num_peers=4)
+        c3.start()
+        # groups were created with num_peers=3 capacity per coordinator;
+        # the three existing coordinators can host one more slot? No:
+        # P=3 means at most 3 members. Remove one first, then add.
+        out = api.remove_member(sid, ("mbg0", "mb2"))
+        assert out[0] == "ok", out
+        await_(
+            lambda: coords[0].by_name["mbg0"].members.count(None) == 1,
+            what="member removed",
+        )
+        members_now = [m for m in coords[0].by_name["mbg0"].members if m]
+        assert ("mbg0", "mb2") not in members_now
+        # still commits with 2 voters
+        r, _ = api.process_command(sid, 5, timeout=20)
+        assert r == 5
+
+        # join the new node as nonvoter; it must catch up and be promoted
+        c3.add_group(
+            "mbg0", "mbcl0",
+            [("mbg0", "mb0"), ("mbg0", "mb1"), ("mbg0", "mb3")],
+            adder(),
+        )
+        out = api.add_member(sid, ("mbg0", "mb3"), voter=False)
+        assert out[0] == "ok", out
+        g0 = coords[0].by_name["mbg0"]
+        slot = g0.slot_of(("mbg0", "mb3"))
+        assert slot >= 0
+        # replication catches the new member up, then auto-promotes it
+        await_(lambda: g0.voter_status.get(slot) == "voter", timeout=30,
+               what="nonvoter promotion")
+        g3 = c3.by_name["mbg0"]
+        await_(lambda: g3.machine_state == 5, what="new member caught up")
+        # committed writes still work with the promoted member
+        r, _ = api.process_command(sid, 2, timeout=20)
+        assert r == 7
+        c3.stop()
+    finally:
+        stop_all(coords)
+
+
+def test_batch_consistent_query():
+    coords = mk_cluster("cq")
+    try:
+        sid = ("cqg0", "cq0")
+        r, _ = api.process_command(sid, 9, timeout=20)
+        out = api.consistent_query(sid, lambda s: s, timeout=20)
+        assert out[0] == "ok" and out[1] == 9, out
+        # redirect from a follower works too
+        out = api.consistent_query(("cqg0", "cq1"), lambda s: s, timeout=20)
+        assert out[0] == "ok" and out[1] == 9, out
+    finally:
+        stop_all(coords)
+
+
+def test_batch_machine_tick_and_timer():
+    coords = mk_cluster("tk", machine=TickMachine,
+                        tick_interval_s=0.1)
+    try:
+        sid = ("tkg0", "tk0")
+        r, _ = api.process_command(sid, 1, timeout=20)
+        assert r == 1
+        g = coords[0].by_name["tkg0"]
+        # machine tick runs on the coordinator's tick sweep
+        await_(lambda: g.machine_state["ticks"] >= 2, what="ticks")
+        # the Timer effect fires a ("timeout", name) machine command
+        await_(lambda: g.machine_state["timeouts"] >= 1, timeout=20,
+               what="timer effect")
+    finally:
+        stop_all(coords)
+
+
+def test_batch_group_on_wal_backed_log(tmp_path):
+    """A coordinator group over the real storage engine: WAL-backed Log,
+    durability-gated acks, restart recovery."""
+    leaderboard.clear()
+    storage = {}
+
+    def mk_storage(node):
+        d = str(tmp_path / node)
+        tables = TableRegistry()
+        coord_ref = {}
+
+        def notify(uid, evt):
+            c = coord_ref.get("c")
+            if c is not None:
+                c.deliver((uid, node), ("log_event", evt), None)
+
+        sw = SegmentWriter(os.path.join(d, "data"), tables, notify)
+        wal = Wal(os.path.join(d, "wal"), tables, notify, segment_writer=sw)
+        storage[node] = (tables, wal, sw, coord_ref, d)
+        return storage[node]
+
+    def mk_log(node, uid):
+        tables, wal, sw, _, d = storage[node]
+        return Log(uid, os.path.join(d, "data", uid), tables, wal)
+
+    names = ["wb0", "wb1", "wb2"]
+    coords = {}
+    for n in names:
+        mk_storage(n)
+        c = BatchCoordinator(n, capacity=8, num_peers=3)
+        storage[n][3]["c"] = c
+        coords[n] = c
+        c.start()
+    try:
+        members = [("wbg0", n) for n in names]
+        for n in names:
+            coords[n].add_group("wbg0", "wbcl0", members, adder(),
+                                log=mk_log(n, "wbg0"))
+        coords["wb0"].deliver(("wbg0", "wb0"), ElectionTimeout(), None)
+        await_(lambda: coords["wb0"].by_name["wbg0"].role == C.R_LEADER,
+               what="election over WAL-backed logs")
+        total = 0
+        for i in range(1, 6):
+            r, _ = api.process_command(("wbg0", "wb0"), i, timeout=30)
+            total += i
+            assert r == total
+        # durable: all three WALs hold the entries
+        for n in names:
+            g = coords[n].by_name["wbg0"]
+            await_(lambda g=g: g.log.last_written()[0] >= 6,
+                   what=f"durability on {n}")
+
+        # restart one follower coordinator from disk: log recovers
+        coords["wb2"].stop()
+        storage["wb2"][1].close()  # wal
+        storage["wb2"][2].close()  # segment writer
+        mk_storage("wb2")
+        c2 = BatchCoordinator("wb2", capacity=8, num_peers=3)
+        storage["wb2"][3]["c"] = c2
+        coords["wb2"] = c2
+        c2.start()
+        c2.add_group("wbg0", "wbcl0", members, adder(), log=mk_log("wb2", "wbg0"))
+        g2 = c2.by_name["wbg0"]
+        # recovered entries are present and re-applied on catch-up
+        assert g2.log.last_index_term()[0] >= 6
+        r, _ = api.process_command(("wbg0", "wb0"), 100, timeout=30)
+        await_(lambda: g2.machine_state == total + 100, timeout=30,
+               what="restarted member re-applies")
+    finally:
+        for c in coords.values():
+            c.stop()
+        for n in names:
+            try:
+                storage[n][1].close()
+                storage[n][2].close()
+            except Exception:
+                pass
+        leaderboard.clear()
