@@ -41,6 +41,8 @@ class Log(LogApi):
         min_snapshot_interval: int = MIN_SNAPSHOT_INTERVAL,
         min_checkpoint_interval: int = MIN_CHECKPOINT_INTERVAL,
         snapshot_store: Optional[SnapshotStore] = None,
+        major_every_minors: int = 2,
+        bg_submit=None,
     ):
         self.uid = uid
         self.server_dir = server_dir
@@ -52,6 +54,12 @@ class Log(LogApi):
         self.snapshots = snapshot_store or SnapshotStore(server_dir)
         self.min_snapshot_interval = min_snapshot_interval
         self.min_checkpoint_interval = min_checkpoint_interval
+        # major compaction policy: schedule a grouping pass every N
+        # minor (snapshot-floor) compactions (the reference's
+        # {num_minors, N} major strategy; cf. src/ra_kv.erl:80-103)
+        self.major_every_minors = major_every_minors
+        self.bg_submit = bg_submit  # None -> run major passes inline
+        self._minors_since_major = 0
 
         # recover tail state
         self._snapshot_meta = self.snapshots.current()
@@ -236,6 +244,24 @@ class Log(LogApi):
         self.tables.set_snapshot_state(self.uid, meta.index, live)
         self.mt.set_first(meta.index + 1, live=live)
         self.segs.truncate_below(meta.index, live)
+        self._minors_since_major += 1
+        if self._minors_since_major >= self.major_every_minors:
+            self._minors_since_major = 0
+            if self.bg_submit is not None:
+                self.bg_submit(lambda: self.segs.major_compact(meta.index, live))
+            else:
+                self.segs.major_compact(meta.index, live)
+
+    def major_compaction(self):
+        """Explicit major compaction pass (grouping + merge + symlink
+        protocol); normally scheduled automatically every
+        ``major_every_minors`` snapshots."""
+        meta = self._snapshot_meta
+        if meta is None:
+            return {"unreferenced": [], "linked": [], "compacted": []}
+        return self.segs.major_compact(
+            meta.index, Seq.from_list(meta.live_indexes)
+        )
 
     def update_release_cursor(
         self, idx: int, cluster, machine_version: int, machine_state: Any,

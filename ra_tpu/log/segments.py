@@ -1,48 +1,117 @@
 """Per-server set of segment files + open-file cache + compaction.
 
 The role of the reference's ``ra_log_segments`` (segment-ref set, FLRU
-fd cache, compaction planning — ``src/ra_log_segments.erl``). Round-1
-compaction scope: snapshot-floor truncation deletes whole segments whose
-range is entirely dead, and minor compaction rewrites a segment that
-still holds live indexes; crash-safe via write-new + atomic rename.
+fd cache, compaction planning — ``src/ra_log_segments.erl:191-344``).
+
+Compaction tiers:
+- snapshot-floor truncation deletes whole segments with no live index
+  and no tail, and minor-compacts straddling segments in place;
+- **major compaction** groups adjacent below-floor segments that are
+  <50% live (by entries or bytes), merges each group's live entries
+  into the group's first segment, and turns the rest into symlinks —
+  crash-safe via the reference's marker protocol
+  (``docs/internals/COMPACTION.md:144-176``): write a
+  ``<first>.compaction_group`` manifest, build ``<first>.compacting``,
+  atomic-rename over the first segment, then symlink the others and
+  delete the manifest. Recovery inspects the manifest to tell a
+  pre-rename crash (discard partial work) from a post-rename one
+  (recreate symlinks).
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ra_tpu.log.segment import SegmentReader, SegmentWriterHandle
 from ra_tpu.protocol import Entry
 from ra_tpu.utils.flru import FLRU
+from ra_tpu.utils.lib import sync_dir
 from ra_tpu.utils.seq import Seq
+
+# symlinks left by major compaction are kept briefly so in-flight
+# readers of the old names can finish (reference: ?SYMLINK_KEEPFOR_S,
+# src/ra_log_segments.erl:41)
+SYMLINK_KEEP_S = 60.0
 
 
 class SegmentSet:
     def __init__(self, dir: str, open_cache: int = 8):
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
+        self._lock = threading.RLock()
         # filename -> (lo, hi) inclusive range
         self.refs: Dict[str, Tuple[int, int]] = {}
         self._cache: FLRU[str, SegmentReader] = FLRU(
             open_cache, on_evict=lambda k, r: r.close()
         )
+        self._recover_compaction()
         for f in sorted(os.listdir(dir)):
-            if f.endswith(".segment"):
+            p = os.path.join(dir, f)
+            if f.endswith(".segment") and not os.path.islink(p):
                 try:
-                    r = SegmentReader(os.path.join(dir, f))
+                    r = SegmentReader(p)
                 except (ValueError, OSError):
                     continue
                 if r.range:
                     self.refs[f] = r.range
                 r.close()
 
+    def _recover_compaction(self) -> None:
+        """Finish or roll back a major compaction interrupted by a crash
+        (reference recovery table, COMPACTION.md:168-176)."""
+        listing = sorted(os.listdir(self.dir))
+        markers = {f[: -len(".compaction_group")] for f in listing
+                   if f.endswith(".compaction_group")}
+        for f in listing:
+            if f.endswith(".segment.compacting"):
+                # minor-compaction temp: always safe to discard
+                os.unlink(os.path.join(self.dir, f))
+                continue
+            if (
+                f.endswith(".compacting")
+                and f[: -len(".compacting")] not in markers
+            ):
+                # major temp created before its marker: roll back
+                os.unlink(os.path.join(self.dir, f))
+                continue
+            if not f.endswith(".compaction_group"):
+                continue
+            marker = os.path.join(self.dir, f)
+            try:
+                with open(marker, "rb") as m:
+                    files = pickle.load(m)
+            except Exception:  # noqa: BLE001 — torn marker: roll back
+                files = []
+            tmp = marker[: -len(".compaction_group")] + ".compacting"
+            if len(files) < 2 or os.path.exists(tmp):
+                # pre-rename crash (or undecidable): discard partial
+                # work, originals are intact
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            else:
+                # rename completed: the first segment holds the merged
+                # data; recreate the symlinks (idempotent)
+                first = files[0]
+                for other in files[1:]:
+                    p = os.path.join(self.dir, other)
+                    if os.path.islink(p):
+                        continue
+                    if os.path.exists(p):
+                        os.unlink(p)
+                    os.symlink(first, p)
+            os.unlink(marker)
+        sync_dir(self.dir)
+
     # -- bookkeeping ------------------------------------------------------
 
     def add_ref(self, fname: str, rng: Tuple[int, int]) -> None:
-        self.refs[fname] = rng
-        self._cache.evict(fname)  # re-open to see new entries
+        with self._lock:
+            self.refs[fname] = rng
+            self._cache.evict(fname)  # re-open to see new entries
 
     def num_segments(self) -> int:
         return len(self.refs)
@@ -66,27 +135,30 @@ class SegmentSet:
     # -- reads ------------------------------------------------------------
 
     def fetch_term(self, idx: int) -> Optional[int]:
-        for f in self.files_for(idx):
-            t = self._reader(f).term(idx)
-            if t is not None:
-                return t
+        with self._lock:
+            for f in self.files_for(idx):
+                t = self._reader(f).term(idx)
+                if t is not None:
+                    return t
         return None
 
     def fetch(self, idx: int) -> Optional[Entry]:
-        for f in self.files_for(idx):
-            got = self._reader(f).read(idx)
-            if got is not None:
-                term, payload = got
-                return Entry(idx, term, pickle.loads(payload))
+        with self._lock:
+            for f in self.files_for(idx):
+                got = self._reader(f).read(idx)
+                if got is not None:
+                    term, payload = got
+                    return Entry(idx, term, pickle.loads(payload))
         return None
 
     def range(self) -> Optional[Tuple[int, int]]:
-        if not self.refs:
-            return None
-        return (
-            min(lo for lo, _ in self.refs.values()),
-            max(hi for _, hi in self.refs.values()),
-        )
+        with self._lock:
+            if not self.refs:
+                return None
+            return (
+                min(lo for lo, _ in self.refs.values()),
+                max(hi for _, hi in self.refs.values()),
+            )
 
     # -- compaction -------------------------------------------------------
 
@@ -96,31 +168,36 @@ class SegmentSet:
         straddle the floor but keep live/tail entries. Returns number of
         files removed."""
         removed = 0
-        for f in sorted(self.refs):
-            lo, hi = self.refs[f]
-            if lo > snapshot_idx:
-                continue
-            # live entries below the floor plus the tail above it survive
-            keep = live.in_range(lo, hi).union(
-                Seq.from_range(max(lo, snapshot_idx + 1), hi)
-            )
-            if keep.is_empty():
-                self._cache.evict(f)
-                try:
-                    os.unlink(os.path.join(self.dir, f))
-                except OSError:
-                    pass
-                del self.refs[f]
-                removed += 1
-            elif len(keep) < (hi - lo + 1):
-                self._minor_compact(f, keep)
+        with self._lock:
+            for f in sorted(self.refs):
+                lo, hi = self.refs[f]
+                if lo > snapshot_idx:
+                    continue
+                # live entries below the floor plus the tail above it
+                # survive
+                keep = live.in_range(lo, hi).union(
+                    Seq.from_range(max(lo, snapshot_idx + 1), hi)
+                )
+                if keep.is_empty():
+                    self._cache.evict(f)
+                    try:
+                        os.unlink(os.path.join(self.dir, f))
+                    except OSError:
+                        pass
+                    del self.refs[f]
+                    removed += 1
+                elif hi > snapshot_idx and len(keep) < (hi - lo + 1):
+                    # only floor-straddling segments are rewritten
+                    # inline; fully-below-floor segments keep their dead
+                    # entries until a major pass groups them (their
+                    # sparseness is the grouping signal — reference
+                    # minor compaction likewise only deletes)
+                    self._minor_compact(f, keep)
         return removed
 
     def _minor_compact(self, fname: str, keep: Seq) -> None:
         """Rewrite fname with only `keep` indexes. Crash-safe: write
-        `.compacting`, fsync, atomic-rename over the original (reference
-        uses the same write-new/rename shape: COMPACTION.md marker
-        protocol)."""
+        `.compacting`, fsync, atomic-rename over the original."""
         src = self._reader(fname)
         tmp_path = os.path.join(self.dir, fname + ".compacting")
         if os.path.exists(tmp_path):
@@ -142,5 +219,151 @@ class SegmentSet:
         if lo is not None:
             self.refs[fname] = (lo, hi)
 
+    # -- major compaction -------------------------------------------------
+
+    def major_compact(
+        self,
+        snapshot_idx: int,
+        live: Seq,
+        max_count: int = 4096,
+    ) -> Dict[str, List[str]]:
+        """Merge groups of sparse below-floor segments (reference:
+        take_group <50% live by entries or bytes, respecting max_count;
+        src/ra_log_segments.erl:191-344). Returns the reference's result
+        shape: {"unreferenced": deleted, "linked": now-symlinks,
+        "compacted": rewritten first segments}."""
+        result: Dict[str, List[str]] = {
+            "unreferenced": [], "linked": [], "compacted": [],
+        }
+        with self._lock:
+            self._prune_symlinks()
+            # evaluate oldest-first; only segments entirely below the
+            # snapshot floor participate (the tail is still hot)
+            candidates: List[Tuple[str, List[int], bool]] = []
+            for f in sorted(self.refs):
+                lo, hi = self.refs[f]
+                if hi > snapshot_idx:
+                    continue
+                r = self._reader(f)
+                live_idx = [i for i in live.in_range(lo, hi) if i in r.index]
+                if not live_idx:
+                    self._cache.evict(f)
+                    try:
+                        os.unlink(os.path.join(self.dir, f))
+                    except OSError:
+                        pass
+                    del self.refs[f]
+                    result["unreferenced"].append(f)
+                    continue
+                total = len(r.index)
+                live_bytes = sum(r.index[i][2] for i in live_idx)
+                total_bytes = sum(e[2] for e in r.index.values()) or 1
+                dense = (
+                    len(live_idx) / total >= 0.5
+                    and live_bytes / total_bytes >= 0.5
+                )
+                # small files stay groupable even when dense, so the
+                # output of earlier major passes keeps folding together
+                # (size-tiered behavior; bounds file count near
+                # total_live / max_count)
+                if total <= max_count // 4:
+                    dense = False
+                candidates.append((f, live_idx, dense))
+
+            groups: List[List[Tuple[str, List[int]]]] = []
+            cur: List[Tuple[str, List[int]]] = []
+            cur_count = 0
+            for f, live_idx, dense in candidates:
+                if dense:
+                    # dense segment breaks adjacency: finalize the group
+                    if len(cur) > 1:
+                        groups.append(cur)
+                    cur, cur_count = [], 0
+                    continue
+                if cur and cur_count + len(live_idx) > max_count:
+                    if len(cur) > 1:
+                        groups.append(cur)
+                    cur, cur_count = [], 0
+                cur.append((f, live_idx))
+                cur_count += len(live_idx)
+            if len(cur) > 1:
+                groups.append(cur)
+
+            for grp in groups:
+                self._merge_group(grp, result)
+        return result
+
+    def _merge_group(self, grp, result) -> None:
+        files = [f for f, _ in grp]
+        first = files[0]
+        stem = first.split(".")[0]
+        marker = os.path.join(self.dir, stem + ".compaction_group")
+        tmp = os.path.join(self.dir, stem + ".compacting")
+        total = sum(len(li) for _, li in grp)
+
+        # 0. the .compacting inode must exist durably BEFORE the marker:
+        # recovery reads "marker present + tmp absent" as "rename
+        # completed", so tmp-after-marker ordering would misclassify a
+        # crash in between as complete and symlink away unmerged data
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        with open(tmp, "wb") as t:
+            t.flush()
+            os.fsync(t.fileno())
+        sync_dir(self.dir)
+
+        # 1. durable manifest of the group
+        with open(marker, "wb") as m:
+            pickle.dump(files, m)
+            m.flush()
+            os.fsync(m.fileno())
+        sync_dir(self.dir)
+
+        # 2. merge all live entries into the .compacting segment
+        w = SegmentWriterHandle(tmp, max_count=max(total, 1))
+        for f, live_idx in grp:
+            r = self._reader(f)
+            for i in live_idx:
+                got = r.read(i)
+                if got is not None:
+                    w.append(i, got[0], got[1])
+        w.sync()
+        w.close()
+        new_range = w.range
+
+        # 3. atomic rename over the FIRST segment (before symlinks, so a
+        # reader following a symlink always sees merged data)
+        for f in files:
+            self._cache.evict(f)
+        os.replace(tmp, os.path.join(self.dir, first))
+        sync_dir(self.dir)
+
+        # 4. the rest become symlinks to the first
+        for other in files[1:]:
+            p = os.path.join(self.dir, other)
+            os.unlink(p)
+            os.symlink(first, p)
+            del self.refs[other]
+            result["linked"].append(other)
+        sync_dir(self.dir)
+
+        # 5. drop the manifest — compaction is complete
+        os.unlink(marker)
+        if new_range is not None:
+            self.refs[first] = new_range
+        result["compacted"].append(first)
+
+    def _prune_symlinks(self) -> None:
+        now = time.time()
+        for f in os.listdir(self.dir):
+            p = os.path.join(self.dir, f)
+            if os.path.islink(p):
+                try:
+                    if now - os.lstat(p).st_mtime > SYMLINK_KEEP_S:
+                        os.unlink(p)
+                except OSError:
+                    pass
+
     def close(self) -> None:
-        self._cache.evict_all()
+        with self._lock:
+            self._cache.evict_all()

@@ -103,7 +103,7 @@ class GroupHost:
         "pending_ack", "snap_accept", "snap_senders", "pre_vote_token",
         "voter_status", "cluster_change_permitted", "cluster_index",
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
-        "noop_index", "noop_committed", "query_seq",
+        "noop_index", "noop_committed", "query_seq", "cluster_history",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -146,7 +146,13 @@ class GroupHost:
         # [{"qi": idx, "fn": fn, "fut": fut, "acks": set()}]
         self.pending_queries: List[Dict[str, Any]] = []
         self.machine_timers: Dict[Any, Any] = {}
-        self.has_tick = type(machine).tick is not Machine.tick
+        # a versioned container may delegate tick to its modules: check
+        # the effective module as well as the container itself
+        self.has_tick = (
+            type(machine).tick is not Machine.tick
+            or type(machine.which_module(machine.version())).tick
+            is not Machine.tick
+        )
         self.snap_floor = 0  # device-known snapshot floor (host mirror)
         # current-term-commit gate: a new leader may neither change
         # membership nor serve linearizable reads until its own noop has
@@ -155,6 +161,11 @@ class GroupHost:
         self.noop_index = 0
         self.noop_committed = True  # groups start pre-election
         self.query_seq = 0
+        # rollback snapshots for write-time cluster adoption: an
+        # uncommitted change adopted from a dead leader must be undone
+        # when a new leader truncates that suffix.
+        # [(entry_index, members_copy, voter_status_copy), ...]
+        self.cluster_history: List[Tuple[int, List, Dict[int, Any]]] = []
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -621,6 +632,10 @@ class BatchCoordinator:
     def _adopt_cluster_cmd(self, g: GroupHost, cmd: Command, entry_index: int = 0) -> None:
         """Follower-side adoption of a replicated cluster change (slot
         coordinates are node-local; only the member set must agree)."""
+        g.cluster_history.append(
+            (entry_index, list(g.members), dict(g.voter_status))
+        )
+        del g.cluster_history[:-8]
         if cmd.kind == RA_JOIN:
             member, voter = cmd.data
             member = tuple(member)
@@ -887,6 +902,18 @@ class BatchCoordinator:
             if not to_write and msg.entries[-1].index > li:
                 to_write = [e for e in msg.entries if e.index > li]
         if to_write:
+            first_idx = to_write[0].index
+            if first_idx <= li and g.cluster_history:
+                # overwriting a divergent suffix: roll back any cluster
+                # adoption that rode on the truncated entries
+                keep = [h for h in g.cluster_history if h[0] < first_idx]
+                undone = [h for h in g.cluster_history if h[0] >= first_idx]
+                if undone:
+                    _, members, voter = undone[0]
+                    g.members = list(members)
+                    g.voter_status = dict(voter)
+                    g.cluster_history = keep
+                    self._sync_member_rows(g)
             g.log.write(list(to_write))
             # followers adopt replicated cluster changes at write time
             # (reference: cluster scan on follower writes,
@@ -964,6 +991,7 @@ class BatchCoordinator:
         machine = g.machine
         mver = g.effective_machine_version
         state = g.machine_state
+        is_leader = g.role == C.R_LEADER
         if not pending and len(entries) > 1 and all(
             type(e.cmd) is Command
             and (e.cmd.kind == USR
@@ -983,12 +1011,13 @@ class BatchCoordinator:
                     g.machine_state = batched
                     g.last_applied = hi
                     self._applied_np[g.gid] = hi
+                    self._commit_gates(g, hi, is_leader)
                     return
             else:
                 g.last_applied = hi
                 self._applied_np[g.gid] = hi
+                self._commit_gates(g, hi, is_leader)
                 return
-        is_leader = g.role == C.R_LEADER
         mac = machine.which_module(mver)
         apply_fn = mac.apply
         me = (g.name, self.name)
@@ -1043,6 +1072,15 @@ class BatchCoordinator:
         g.machine_state = state
         g.last_applied = hi
         self._applied_np[g.gid] = hi
+
+    def _commit_gates(self, g: GroupHost, hi: int, is_leader: bool) -> None:
+        """Noop-commit gate for apply paths that skip the per-entry loop
+        (cluster entries always force the per-entry path, so reaching
+        ``hi >= noop_index`` here means the noop itself committed)."""
+        if is_leader and not g.noop_committed and hi >= g.noop_index:
+            g.noop_committed = True
+            if g.cluster_index <= hi:
+                g.cluster_change_permitted = True
 
     # -- machine effects (batch-backend executor; reference vocabulary:
     # src/ra_machine.erl:131-159, realised per src/ra_server_proc.erl
@@ -1253,7 +1291,8 @@ class BatchCoordinator:
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
             return
         if isinstance(msg, tuple) and msg and msg[0] == "machine_tick":
-            effs = g.machine.tick(msg[1], g.machine_state)
+            mac = g.machine.which_module(g.effective_machine_version)
+            effs = mac.tick(msg[1], g.machine_state)
             if effs and g.role == C.R_LEADER:
                 self._realise_effects(g, effs)
             return
@@ -1363,9 +1402,15 @@ class BatchCoordinator:
             self._reply(fut, ("ok", fn(g.machine_state), me))
             return
         now = time.monotonic()
-        g.pending_queries = [
-            q for q in g.pending_queries if now - q["t"] < 10.0
-        ]
+        fresh = []
+        for q in g.pending_queries:
+            if now - q["t"] < 10.0:
+                fresh.append(q)
+            else:
+                # quorum never arrived (lost heartbeat, shrunk voter
+                # set): tell the caller to retry instead of hanging
+                self._reply(q["fut"], ("redirect", None))
+        g.pending_queries = fresh
         g.query_seq += 1
         qid = g.query_seq
         g.pending_queries.append(

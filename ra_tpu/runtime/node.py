@@ -167,6 +167,7 @@ class RaNode:
                 self.wal,
                 min_snapshot_interval=self.config.min_snapshot_interval,
                 min_checkpoint_interval=self.config.min_checkpoint_interval,
+                bg_submit=self.bg.submit,  # major compaction off-thread
             )
             cfg = ServerConfig(
                 server_id=sid,
