@@ -73,6 +73,7 @@ WAL_FIELDS: List[FieldSpec] = [
     ("batch_size", "gauge", "last batch size"),
     ("out_of_seq", "counter", "out-of-sequence writes detected"),
     ("rollovers", "counter", "WAL file rollovers"),
+    ("failures", "counter", "I/O failures (WAL entered failed state)"),
 ]
 
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [

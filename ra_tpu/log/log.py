@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from ra_tpu.log.api import LogApi
@@ -60,6 +61,8 @@ class Log(LogApi):
         self.major_every_minors = major_every_minors
         self.bg_submit = bg_submit  # None -> run major passes inline
         self._minors_since_major = 0
+        self.resend_window_s = 20.0
+        self._last_resend_t = float("-inf")
 
         # recover tail state
         self._snapshot_meta = self.snapshots.current()
@@ -162,13 +165,35 @@ class Log(LogApi):
             self.mt.record_flushed(seq)
             return []
         if tag == "resend_write":
+            # throttled: a flood of gap notifications must not re-queue
+            # the same tail repeatedly (reference: resend_window_seconds,
+            # src/ra_log.erl:65,1651)
             _, from_idx = evt
-            for i in range(from_idx, self._last_index + 1):
-                e = self.mt.get(i)
-                if e is not None:
-                    self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
+            self._resend(from_idx)
+            return []
+        if tag == "wal_up":
+            # the WAL came back after a failure: resend everything past
+            # the durable watermark (bypasses the throttle — this is the
+            # recovery moment itself)
+            self._resend(self._written_index + 1, force=True)
             return []
         return []
+
+    def _resend(self, from_idx: int, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and (now - self._last_resend_t) < self.resend_window_s:
+            return
+        self._last_resend_t = now
+        if force:
+            # post-failure resend: truncate markers issued while the WAL
+            # was down were dropped, and the retained failed file may
+            # hold a since-discarded suffix — re-establish the cut in
+            # the fresh file before replaying the current tail
+            self.wal.truncate_write(self.uid, from_idx)
+        for i in range(from_idx, self._last_index + 1):
+            e = self.mt.get(i)
+            if e is not None:
+                self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
 
     # ------------------------------------------------------------------
     # reads

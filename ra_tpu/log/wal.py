@@ -43,6 +43,7 @@ MAGIC = b"RTW1"
 K_UID = 1
 K_ENTRY = 2
 K_TRUNC = 3
+K_SPARSE = 4  # entry layout; no gap/truncate semantics on recovery
 
 _ENTRY_HDR = struct.Struct("<BHQQII")
 _UID_HDR = struct.Struct("<BHH")
@@ -89,6 +90,15 @@ class Wal:
         self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
         self._closed = False
+        # failure handling: an I/O error flips the WAL into a failed
+        # state (writes rejected) until reopen() rolls a fresh file —
+        # the analog of the reference WAL process crashing and being
+        # supervisor-restarted (src/ra_log_wal.erl + ra_log_wal_sup)
+        self._failed = False
+        self.on_failure: Optional[Callable[[BaseException], None]] = None
+        # serializes file I/O (writer thread) against reopen() (restart
+        # thread) — without it a reopen can close the file mid-write
+        self._io_lock = threading.Lock()
 
         # per-open-file state
         self._file = None
@@ -118,7 +128,7 @@ class Wal:
         writes (snapshot install pre-phase) that bypass gap detection.
         Returns False when the WAL is closed."""
         with self._cv:
-            if self._closed:
+            if self._closed or self._failed:
                 return False
             self._queue.append(("s" if sparse else "w", uid, idx, term, payload))
             self._cv.notify()
@@ -128,7 +138,7 @@ class Wal:
         """Record an explicit truncate-from marker (divergent suffix
         rewrite starts at idx)."""
         with self._cv:
-            if self._closed:
+            if self._closed or self._failed:
                 return False
             self._queue.append(("t", uid, idx, 0, b""))
             self._cv.notify()
@@ -213,7 +223,7 @@ class Wal:
                     resends.append((uid, max(last, snap_idx) + 1))
                     continue
             ref = self._uid_ref(uid, records)
-            records.append((K_ENTRY, ref, idx, term, payload))
+            records.append((K_SPARSE if kind == "s" else K_ENTRY, ref, idx, term, payload))
             seq = self._file_seqs.get(uid, Seq.empty())
             if kind == "s":
                 # sparse writes never imply truncation of higher indexes
@@ -228,8 +238,23 @@ class Wal:
 
         if records:
             buf = self._frame(records)
-            self._file.write(buf)
-            self._sync()
+            err = None
+            with self._io_lock:
+                if self._failed:
+                    return  # failed window: batch is unacked, drop it
+                try:
+                    self._file.write(buf)
+                    self._sync()
+                except (OSError, ValueError) as exc:
+                    err = exc
+            if err is not None:
+                # the whole batch is unacked (no written events fire) —
+                # entries survive in memtables; servers hold/resend once
+                # reopen() brings a fresh file up. (_fail outside the io
+                # lock: it takes the queue lock, which reopen holds
+                # while waiting for the io lock.)
+                self._fail(err)
+                return
             self.counter.incr("batches")
             self.counter.incr("writes", len(batch))
             self.counter.incr("bytes_written", len(buf))
@@ -277,13 +302,13 @@ class Wal:
                 buf += payload
             elif kind == K_TRUNC:
                 buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
-            else:
+            else:  # K_ENTRY / K_SPARSE share the layout
                 crc = (
                     zlib.crc32(struct.pack("<QQ", idx, term) + payload)
                     if self.compute_checksums
                     else 0
                 )
-                buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc, len(payload))
+                buf += _ENTRY_HDR.pack(kind, ref, idx, term, crc, len(payload))
                 buf += payload
         return bytes(buf)
 
@@ -312,13 +337,53 @@ class Wal:
                 {uid: seq for uid, seq in seqs.items() if not seq.is_empty()},
                 wal_file=full_path,
             )
-        else:
-            os.unlink(full_path)
+        # no segment writer: the rolled file is the only durable copy of
+        # its entries — keep it for boot-time recovery
 
     def force_rollover(self) -> None:
         """Test/ops hook: roll the current file regardless of size."""
         with self._lock:
             self._rollover()
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._cv:
+            if self._failed:
+                return  # one failure episode -> one on_failure callback
+            self._failed = True
+        self.counter.incr("failures")
+        cb = self.on_failure
+        if cb is not None:
+            try:
+                cb(exc)
+            except Exception:  # noqa: BLE001
+                pass
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
+
+    def reopen(self) -> bool:
+        """Roll to a fresh file after a failure (the supervisor-restart
+        analog). The failed file stays on disk — acked batches in it are
+        durable and boot recovery re-reads it. Per-writer gap state is
+        reset so servers' resent tails are accepted in-seq."""
+        with self._cv:
+            if not self._failed:
+                return True  # another reopen already succeeded
+            with self._io_lock:
+                try:
+                    if self._file is not None:
+                        try:
+                            self._file.close()
+                        except OSError:
+                            pass
+                    self._queue.clear()  # unacked queue: servers resend
+                    self._open_next()
+                    self._last_idx = {}
+                    self._failed = False
+                    return True
+                except OSError:
+                    return False
 
     def _recover(self) -> None:
         """Re-read surviving WAL files into memtables and hand them to the
@@ -331,44 +396,107 @@ class Wal:
 
         for fname in files:
             path = os.path.join(self.dir, fname)
-            seqs: Dict[str, Seq] = {}
-            uids: Dict[int, str] = {}
-            try:
-                data = open(path, "rb").read()
-            except OSError:
+            live_seqs = self._recover_file(path, Entry, pickle)
+            if live_seqs is None:
                 continue
-            if not data.startswith(MAGIC):
+            if self.segment_writer is not None and live_seqs:
+                self.segment_writer.flush_mem_tables(live_seqs, wal_file=path)
+            elif not live_seqs:
                 os.unlink(path)
-                continue
-            pos = 4
-            n = len(data)
-            while pos < n:
-                kind = data[pos]
+            # else: no segment writer configured — the file is the only
+            # durable copy of these entries (the memtable rebuild above is
+            # RAM only), so it must survive until a segment writer flushes
+            # it; recovery re-reads it next boot (idempotent inserts)
+            num = int(fname.split(".")[0])
+            self._file_num = max(self._file_num, num)
+
+    # recovery streams files in bounded chunks instead of loading them
+    # whole (a 256 MB WAL x several files must not need that much RAM at
+    # boot; reference reads 32 MB chunks, src/ra_log_wal.erl:393-470)
+    RECOVER_CHUNK = 8 * 1024 * 1024
+
+    def _recover_file(self, path: str, Entry, pickle) -> Optional[Dict[str, Seq]]:
+        """Parse one WAL file streaming; returns {uid: live seq} or None
+        when the file was unreadable/invalid (and removed)."""
+        seqs: Dict[str, Seq] = {}
+        uids: Dict[int, str] = {}
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return None
+        with f:
+            if f.read(4) != MAGIC:
+                f.close()
+                os.unlink(path)
+                return None
+            buf = b""
+            pos = 0
+            eof = False
+
+            def ensure(n: int) -> bool:
+                nonlocal buf, pos, eof
+                while len(buf) - pos < n and not eof:
+                    chunk = f.read(self.RECOVER_CHUNK)
+                    if not chunk:
+                        eof = True
+                        break
+                    buf = buf[pos:] + chunk
+                    pos = 0
+                return len(buf) - pos >= n
+
+            while True:
+                if not ensure(1):
+                    break
+                kind = buf[pos]
                 try:
                     if kind == K_UID:
-                        _, ref, ln = _UID_HDR.unpack_from(data, pos)
+                        if not ensure(_UID_HDR.size):
+                            break
+                        _, ref, ln = _UID_HDR.unpack_from(buf, pos)
+                        if not ensure(_UID_HDR.size + ln):
+                            break
                         pos += _UID_HDR.size
-                        uids[ref] = data[pos : pos + ln].decode()
+                        uids[ref] = buf[pos : pos + ln].decode()
                         pos += ln
                     elif kind == K_TRUNC:
-                        _, ref, idx = _TRUNC_HDR.unpack_from(data, pos)
+                        if not ensure(_TRUNC_HDR.size):
+                            break
+                        _, ref, idx = _TRUNC_HDR.unpack_from(buf, pos)
                         pos += _TRUNC_HDR.size
                         uid = uids[ref]
                         self.tables.mem_table(uid).truncate_from(idx)
                         seqs[uid] = seqs.get(uid, Seq.empty()).limit(idx - 1)
                         self._last_idx[uid] = idx - 1
-                    elif kind == K_ENTRY:
-                        _, ref, idx, term, crc, ln = _ENTRY_HDR.unpack_from(data, pos)
-                        pos += _ENTRY_HDR.size
-                        payload = data[pos : pos + ln]
-                        if len(payload) < ln:
+                    elif kind in (K_ENTRY, K_SPARSE):
+                        if not ensure(_ENTRY_HDR.size):
+                            break
+                        _, ref, idx, term, crc, ln = _ENTRY_HDR.unpack_from(buf, pos)
+                        if not ensure(_ENTRY_HDR.size + ln):
                             break  # torn tail
+                        pos += _ENTRY_HDR.size
+                        payload = buf[pos : pos + ln]
                         pos += ln
                         if self.compute_checksums and crc:
                             if zlib.crc32(struct.pack("<QQ", idx, term) + payload) != crc:
                                 break  # corrupt tail
                         uid = uids[ref]
+                        # pre-init registered this uid's snapshot floor
+                        # before recovery ran: skip dead indexes instead
+                        # of resurrecting them (reference:
+                        # ra_log_pre_init.erl:31-45)
+                        snap_idx = self.tables.snapshot_index(uid)
+                        if idx <= snap_idx and idx not in self.tables.live_indexes(uid):
+                            self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+                            continue
                         mt = self.tables.mem_table(uid)
+                        if kind == K_SPARSE:
+                            # sparse records carry no contiguity or
+                            # truncation semantics: never rewind the
+                            # writer watermark or clip higher entries
+                            mt.insert_sparse(Entry(idx, term, pickle.loads(payload)))
+                            seqs[uid] = seqs.get(uid, Seq.empty()).add(idx)
+                            self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+                            continue
                         mt.insert(Entry(idx, term, pickle.loads(payload)))
                         seq = seqs.get(uid, Seq.empty())
                         if idx <= (seq.last() or 0):
@@ -379,17 +507,7 @@ class Wal:
                         break  # unknown/corrupt: stop at tail
                 except (struct.error, KeyError, IndexError, EOFError):
                     break
-            live = {u: s for u, s in seqs.items() if not s.is_empty()}
-            if self.segment_writer is not None and live:
-                self.segment_writer.flush_mem_tables(live, wal_file=path)
-            elif not live:
-                os.unlink(path)
-            # else: no segment writer configured — the file is the only
-            # durable copy of these entries (the memtable rebuild above is
-            # RAM only), so it must survive until a segment writer flushes
-            # it; recovery re-reads it next boot (idempotent inserts)
-            num = int(fname.split(".")[0])
-            self._file_num = max(self._file_num, num)
+        return {u: s for u, s in seqs.items() if not s.is_empty()}
 
     def overview(self) -> Dict[str, Any]:
         return {

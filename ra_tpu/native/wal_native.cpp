@@ -11,6 +11,8 @@
 //   entry   : kind=2 | ref u16 | idx u64 | term u64 | crc u32 | len u32
 //             | payload
 //   trunc   : kind=3 | ref u16 | idx u64
+//   sparse  : kind=4 | layout identical to entry (no gap/truncate
+//             semantics on recovery)
 //
 // Build: g++ -O2 -shared -fPIC -o wal_native.so wal_native.cpp
 // (no external deps; CRC32 implemented here, polynomial 0xEDB88320,
@@ -44,7 +46,7 @@ extern "C" {
 // Returns the number of bytes written into `out` (caller sizes it via
 // wal_frame_bound), or -1 if out_cap would be exceeded.
 //
-// kinds[i]: 1=uid-def, 2=entry, 3=trunc
+// kinds[i]: 1=uid-def, 2=entry, 3=trunc, 4=sparse entry
 // refs[i]:  writer ref
 // idxs[i], terms[i]: entry/trunc fields (uid-def: idx = uid byte length)
 // offs[i]..offs[i]+lens[i]: payload slice in `blob` (entry payload or
@@ -75,10 +77,10 @@ long wal_frame_batch(
             uint16_t l16 = (uint16_t)ln;
             memcpy(out + w, &l16, 2); w += 2;
             memcpy(out + w, blob + offs[i], ln); w += ln;
-        } else if (kind == 2) {  // entry: B H Q Q I I + payload
+        } else if (kind == 2 || kind == 4) {  // entry / sparse entry
             uint32_t ln = lens[i];
             if (w + 27 + (long)ln > out_cap) return -1;
-            out[w++] = 2;
+            out[w++] = kind;
             memcpy(out + w, &refs[i], 2); w += 2;
             memcpy(out + w, &idxs[i], 8); w += 8;
             memcpy(out + w, &terms[i], 8); w += 8;
@@ -115,7 +117,7 @@ long wal_frame_bound(const uint8_t* kinds, const uint32_t* lens, long n) {
     long total = 0;
     for (long i = 0; i < n; i++) {
         if (kinds[i] == 1) total += 5 + lens[i];
-        else if (kinds[i] == 2) total += 27 + lens[i];
+        else if (kinds[i] == 2 || kinds[i] == 4) total += 27 + lens[i];
         else total += 11;
     }
     return total;

@@ -87,6 +87,14 @@ class RaNode:
         self._client_sinks: Dict[Any, Callable[[ServerId, list], None]] = {}
         self._lock = threading.Lock()
 
+        # boot order mirrors the reference's ra_log_sup: meta/directory
+        # first, then PRE-INIT registers every server's snapshot floor,
+        # THEN WAL recovery runs — so recovery can skip dead indexes
+        # instead of resurrecting them (reference:
+        # src/ra_log_pre_init.erl:31-45, src/ra_log_sup.erl:20-63)
+        self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
+        self.directory = Directory(self.meta)
+        self._pre_init()
         self.sw = SegmentWriter(
             os.path.join(self.dir, "data"),
             self.tables,
@@ -105,8 +113,7 @@ class RaNode:
             compute_checksums=self.config.wal_compute_checksums,
             threaded=True,
         )
-        self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
-        self.directory = Directory(self.meta)
+        self.wal.on_failure = self._on_wal_failure
         self._registry = nodes or node_registry()
         if tcp:
             # real sockets: name must be "host:port"; peers are remote
@@ -232,6 +239,51 @@ class RaNode:
             self.tables.delete_mem_table(uid)
             self.tables.delete_snapshot_state(uid)
             shutil.rmtree(os.path.join(self.dir, "data", uid), ignore_errors=True)
+
+    def _pre_init(self) -> None:
+        """Register snapshot floors for every registered server BEFORE
+        WAL recovery (reference: ra_log_pre_init.erl:31-45)."""
+        from ra_tpu.log.snapshot import SnapshotStore
+        from ra_tpu.utils.seq import Seq
+
+        for uid, _name, _cluster in self.directory.registered():
+            d = os.path.join(self.dir, "data", uid)
+            if not os.path.isdir(d):
+                continue
+            try:
+                meta = SnapshotStore(d).current()
+            except Exception:  # noqa: BLE001 — unreadable: no floor
+                continue
+            if meta is not None:
+                self.tables.set_snapshot_state(
+                    uid, meta.index, Seq.from_list(meta.live_indexes)
+                )
+
+    def _on_wal_failure(self, exc: BaseException) -> None:
+        """The shared WAL hit an I/O error: put every server into
+        await_condition, then restart the WAL on a fresh file with
+        backoff (the supervision analog; on success servers get wal_up
+        and resend their unwritten tails)."""
+        for proc in list(self.procs.values()):
+            proc.enqueue(LogEvent(("wal_down",)))
+
+        def restart():
+            import time as _t
+
+            delay = 0.05
+            while self.running:
+                if self.wal.reopen():
+                    for proc in list(self.procs.values()):
+                        proc.enqueue(LogEvent(("wal_up",)))
+                    return
+                # keep retrying forever with capped backoff: a disk that
+                # recovers minutes later must still heal the node
+                _t.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+        threading.Thread(
+            target=restart, name=f"ra-wal-restart-{self.name}", daemon=True
+        ).start()
 
     def recover_registered(self) -> None:
         """server_recovery_strategy=registered: restart every registered

@@ -422,6 +422,10 @@ class ServerProc:
     def _on_state_enter(self, role: str) -> None:
         if role in (PRE_VOTE, CANDIDATE):
             self.arm_election_timer()  # retry a stalled election round
+        elif role == "await_condition":
+            # the election timeout doubles as the condition timeout
+            # (server._handle_await_condition falls back to follower)
+            self.arm_election_timer()
         elif role == LEADER:
             self.timers.cancel(self._election_ref)
             self._election_ref = None

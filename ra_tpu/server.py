@@ -320,6 +320,14 @@ class Server:
             return self.handle(msg.msg, from_peer=msg.peer)
         if isinstance(msg, tuple) and msg and msg[0] == "force_shrink":
             return self._force_shrink(msg[1] if len(msg) > 1 else None)
+        if (
+            isinstance(msg, LogEvent)
+            and isinstance(msg.evt, tuple)
+            and msg.evt
+            and msg.evt[0] == "wal_down"
+            and self.role != AWAIT_CONDITION
+        ):
+            return self._on_wal_down()
         handler = {
             FOLLOWER: self._handle_follower,
             PRE_VOTE: self._handle_pre_vote,
@@ -1428,6 +1436,33 @@ class Server:
     def await_condition(self, cond: Condition, effects: EffectList) -> None:
         self.condition = cond
         self._become(AWAIT_CONDITION, effects)
+
+    def _on_wal_down(self) -> EffectList:
+        """The shared WAL failed. A leader that cannot persist must
+        abdicate (transfer to the most caught-up voter); every role then
+        holds in await_condition until the WAL is back, at which point
+        the re-injected wal_up event drives the unwritten-tail resend
+        (reference: src/ra_server.erl:653-693, 1918-1961)."""
+        effects: EffectList = []
+        if self.role == LEADER:
+            target = None
+            best = -1
+            for sid, p in self.peers().items():
+                if p.is_voter() and p.match_index > best:
+                    target, best = sid, p.match_index
+            if target is not None:
+                effects.append(SendRpc(target, TimeoutNow()))
+
+        def wal_is_up(_srv: "Server", m: Any) -> bool:
+            return (
+                isinstance(m, LogEvent)
+                and isinstance(m.evt, tuple)
+                and bool(m.evt)
+                and m.evt[0] == "wal_up"
+            )
+
+        self.await_condition(Condition(predicate=wal_is_up), effects)
+        return effects
 
     # ------------------------------------------------------------------
     # aux machine plumbing

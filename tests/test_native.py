@@ -29,6 +29,7 @@ def test_frame_batch_byte_parity(tmp_path):
         (3, 1, 5, 0, b""),               # trunc marker
         (1, 2, 3, 0, b"ab2"),
         (2, 2, 10, 3, b"x" * 1000),
+        (4, 2, 50, 3, pickle.dumps("sparse")),  # sparse entry record
     ]
     wal = Wal(str(tmp_path / "w"), TableRegistry(), lambda u, e: None,
               threaded=False, sync_method="none", native=False)
