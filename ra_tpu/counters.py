@@ -39,6 +39,7 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
     ("snapshots_written", "counter", "snapshots written"),
     ("snapshot_installed", "counter", "snapshots installed (follower)"),
     ("checkpoints_written", "counter", "checkpoints written"),
+    ("recovery_checkpoint_used", "counter", "boots that skipped replay"),
     ("checkpoints_promoted", "counter", "checkpoints promoted to snapshots"),
     ("checkpoint_index", "gauge", "latest checkpoint index"),
     ("aer_received", "counter", "append_entries RPCs received"),

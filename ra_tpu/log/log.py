@@ -356,6 +356,10 @@ class Log(LogApi):
     def read_recovery_checkpoint(self) -> Optional[Tuple[SnapshotMeta, Any]]:
         return self.snapshots.read(RECOVERY)
 
+    def discard_recovery_checkpoint(self) -> None:
+        """Recovery checkpoints are single-use (consumed at boot)."""
+        self.snapshots.delete_kind(RECOVERY)
+
     # ------------------------------------------------------------------
 
     def close(self) -> None:

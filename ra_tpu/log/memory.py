@@ -234,3 +234,14 @@ class MemoryLog(LogApi):
 
     def read_snapshot(self) -> Optional[Tuple[SnapshotMeta, Any]]:
         return self._snapshot
+
+    # recovery checkpoints (orderly-shutdown replay skip)
+
+    def write_recovery_checkpoint(self, meta: SnapshotMeta, machine_state: Any) -> None:
+        self._recovery = (meta, machine_state)
+
+    def read_recovery_checkpoint(self) -> Optional[Tuple[SnapshotMeta, Any]]:
+        return getattr(self, "_recovery", None)
+
+    def discard_recovery_checkpoint(self) -> None:
+        self._recovery = None

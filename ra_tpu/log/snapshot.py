@@ -230,7 +230,10 @@ class SnapshotStore:
         self.write(meta, state, kind=SNAPSHOT)
         return state
 
+    def delete_kind(self, kind: str) -> None:
+        shutil.rmtree(self._kind_dir(kind), ignore_errors=True)
+        os.makedirs(self._kind_dir(kind), exist_ok=True)
+
     def delete_all(self) -> None:
         for kind in (SNAPSHOT, CHECKPOINT, RECOVERY):
-            shutil.rmtree(self._kind_dir(kind), ignore_errors=True)
-            os.makedirs(self._kind_dir(kind), exist_ok=True)
+            self.delete_kind(kind)

@@ -84,6 +84,37 @@ class Machine:
         return None, aux_state
 
 
+# -- machine factories -------------------------------------------------------
+# Cold restart needs to reconstruct machines from persisted config alone
+# (the reference stores the machine module atom in the server config and
+# Erlang modules are globally addressable — src/ra_server_sup_sup.erl
+# recover_config/2). The Python analog: a registered factory name or a
+# "module:attr" dotted path, persisted in __server_config__ and resolved
+# at boot.
+
+_FACTORIES: Dict[str, Callable[[Dict[str, Any]], "Machine"]] = {}
+
+
+def register_machine_factory(name: str, fn: Callable[[Dict[str, Any]], "Machine"]) -> None:
+    _FACTORIES[name] = fn
+
+
+def resolve_machine_factory(spec: str, machine_config: Optional[Dict[str, Any]] = None) -> "Machine":
+    """Build a machine from a persisted factory spec: a name registered
+    via ``register_machine_factory`` or an importable ``module:attr``
+    callable taking the machine_config dict."""
+    cfg = machine_config or {}
+    fn = _FACTORIES.get(spec)
+    if fn is None and ":" in spec:
+        import importlib
+
+        mod, attr = spec.split(":", 1)
+        fn = getattr(importlib.import_module(mod), attr)
+    if fn is None:
+        raise KeyError(f"unknown machine factory {spec!r}")
+    return fn(cfg)
+
+
 def normalize_apply_result(res) -> Tuple[Any, Any, List[Effect]]:
     if isinstance(res, tuple):
         if len(res) == 2:

@@ -213,6 +213,22 @@ class HeartbeatReply:
     query_index: int
 
 
+@dataclasses.dataclass(frozen=True)
+class InfoRpc:
+    """Peer-capability probe (reference: #info_rpc{} src/ra.hrl:202) —
+    the leader discovers followers' supported machine versions to gate
+    upgrade strategies."""
+
+    term: int
+    leader_id: ServerId
+
+
+@dataclasses.dataclass(frozen=True)
+class InfoReply:
+    term: int
+    machine_version: int
+
+
 # -- events delivered to the server core (non-peer messages) ---------------
 
 

@@ -142,28 +142,47 @@ class RaNode:
     # ------------------------------------------------------------------
     # server lifecycle (reference: ra_server_sup_sup start/restart/delete)
 
+    # config keys that may change when a server restarts (reference:
+    # ?MUTABLE_CONFIG_KEYS, src/ra_server_sup_sup.erl:12-21)
+    MUTABLE_CONFIG_KEYS = frozenset(
+        {"machine_config", "max_pipeline_count", "max_aer_batch_size",
+         "machine_upgrade_strategy"}
+    )
+
     def start_server(
         self,
         name: str,
         cluster_name: str,
-        machine: Machine,
+        machine: Optional[Machine],
         initial_members: Tuple[ServerId, ...],
         uid: Optional[str] = None,
         machine_config: Optional[dict] = None,
+        machine_factory: Optional[str] = None,
+        _extra_cfg: Optional[dict] = None,
     ) -> ServerId:
         with self._lock:
             if name in self.procs:
                 raise RuntimeError(f"server {name!r} already running on {self.name}")
             uid = uid or self.directory.uid_of(name) or f"{cluster_name}_{name}"
             sid: ServerId = (name, self.name)
+            if machine is None:
+                if machine_factory is None:
+                    raise ValueError("machine or machine_factory required")
+                from ra_tpu.machine import resolve_machine_factory
+
+                machine = resolve_machine_factory(machine_factory, machine_config)
             self.directory.register(uid, name, cluster_name)
             # persist enough config to restart this server after a crash
+            # — including a resolvable machine factory, so a COLD restart
+            # (fresh process) can rebuild the machine from disk
             self.meta.store_sync(
                 uid,
                 "__server_config__",
                 {"name": name, "cluster": cluster_name,
                  "members": tuple(initial_members),
-                 "machine_config": machine_config or {}},
+                 "machine_config": machine_config or {},
+                 "machine_factory": machine_factory,
+                 **(_extra_cfg or {})},
             )
             self._machines = getattr(self, "_machines", {})
             self._machines[uid] = machine
@@ -176,15 +195,25 @@ class RaNode:
                 min_checkpoint_interval=self.config.min_checkpoint_interval,
                 bg_submit=self.bg.submit,  # major compaction off-thread
             )
+            extra = _extra_cfg or {}
             cfg = ServerConfig(
                 server_id=sid,
                 uid=uid,
                 cluster_name=cluster_name,
                 machine=machine,
                 initial_members=tuple(initial_members),
-                max_pipeline_count=self.config.default_max_pipeline_count,
-                max_aer_batch_size=self.config.default_max_append_entries_rpc_batch_size,
+                max_pipeline_count=extra.get(
+                    "max_pipeline_count", self.config.default_max_pipeline_count
+                ),
+                max_aer_batch_size=extra.get(
+                    "max_aer_batch_size",
+                    self.config.default_max_append_entries_rpc_batch_size,
+                ),
                 machine_config=machine_config,
+                machine_upgrade_strategy=extra.get(
+                    "machine_upgrade_strategy",
+                    self.config.machine_upgrade_strategy,
+                ),
             )
             server = Server(cfg, log, self.meta)
             server.recover()
@@ -192,25 +221,56 @@ class RaNode:
             self.procs[name] = proc
             return sid
 
-    def restart_server(self, name: str) -> ServerId:
+    def restart_server(
+        self, name: str, overrides: Optional[dict] = None, orderly: bool = True
+    ) -> ServerId:
+        """Restart from persisted config; ``overrides`` may change only
+        MUTABLE_CONFIG_KEYS (reference: restart with mutable keys,
+        src/ra_server_sup_sup.erl:12-21)."""
         uid = self.directory.uid_of(name)
         if uid is None:
             raise RuntimeError(f"unknown server {name!r}")
         rec = self.meta.fetch(uid, "__server_config__")
+        if rec is None:
+            raise RuntimeError(f"no persisted config for {name!r}")
+        if overrides:
+            bad = set(overrides) - self.MUTABLE_CONFIG_KEYS
+            if bad:
+                raise ValueError(f"immutable config keys on restart: {sorted(bad)}")
+            rec = {**rec, **overrides}
+            self.meta.store_sync(uid, "__server_config__", rec)
         machine = getattr(self, "_machines", {}).get(uid)
-        if rec is None or machine is None:
-            raise RuntimeError(f"no persisted config/machine for {name!r}")
-        self.stop_server(name)
+        if overrides and "machine_config" in overrides:
+            # a changed machine_config only takes effect through the
+            # factory; the cached machine instance holds the old config
+            if rec.get("machine_factory") is None:
+                raise ValueError(
+                    "machine_config override requires a machine_factory"
+                )
+            machine = None
+        self.stop_server(name, orderly=orderly)
         return self.start_server(
             name, rec["cluster"], machine, rec["members"], uid=uid,
             machine_config=rec.get("machine_config"),
+            machine_factory=rec.get("machine_factory"),
+            _extra_cfg={
+                k: rec[k]
+                for k in ("max_pipeline_count", "max_aer_batch_size",
+                          "machine_upgrade_strategy")
+                if k in rec
+            },
         )
 
-    def stop_server(self, name: str) -> None:
+    def stop_server(self, name: str, orderly: bool = True) -> None:
         with self._lock:
             proc = self.procs.pop(name, None)
         if proc is not None:
             proc.kill()
+            if orderly:
+                # capture AFTER the actor stopped: last_applied and
+                # machine_state must be a coherent pair (a live actor
+                # could apply between the two reads)
+                self._write_recovery_checkpoint(proc)
             proc.server.log.close()
             self.ra_state.pop(proc.server.cfg.uid, None)
             # leader-process monitoring: tell every node this proc died
@@ -287,19 +347,69 @@ class RaNode:
 
     def recover_registered(self) -> None:
         """server_recovery_strategy=registered: restart every registered
-        server (machines must be re-suppliable via registered factories)."""
+        server — machines come from the in-memory table or, on a cold
+        boot, from the persisted machine factory."""
         for uid, name, cluster in self.directory.registered():
             machine = getattr(self, "_machines", {}).get(uid)
             rec = self.meta.fetch(uid, "__server_config__")
-            if machine is not None and rec is not None and name not in self.procs:
-                self.start_server(name, cluster, machine, rec["members"], uid=uid)
+            if rec is None or name in self.procs:
+                continue
+            if machine is None and rec.get("machine_factory") is None:
+                continue  # not reconstructable: skip (legacy servers)
+            try:
+                self.start_server(
+                    name, cluster, machine, rec["members"], uid=uid,
+                    machine_config=rec.get("machine_config"),
+                    machine_factory=rec.get("machine_factory"),
+                    _extra_cfg={
+                        k: rec[k]
+                        for k in ("max_pipeline_count", "max_aer_batch_size",
+                                  "machine_upgrade_strategy")
+                        if k in rec
+                    },
+                )
+            except Exception:  # noqa: BLE001 — one bad server must not
+                # block recovery of the rest (or the whole node boot)
+                traceback.print_exc()
+
+    def _write_recovery_checkpoint(self, proc) -> None:
+        """Orderly-shutdown capture so the next boot can skip replay
+        (reference: maybe_write_recovery_checkpoint,
+        src/ra_server.erl:2708-2762)."""
+        from ra_tpu.protocol import SnapshotMeta
+
+        srv = proc.server
+        try:
+            # the tick-driven last_applied persistence is async; make the
+            # final watermark durable so boot replay targets it even if
+            # the checkpoint below is unusable
+            self.meta.store_sync(srv.cfg.uid, "last_applied", srv.last_applied)
+            idx = srv.last_applied
+            snap = srv.log.snapshot_index_term()
+            if idx <= (snap[0] if snap else 0):
+                return  # snapshot already covers the applied prefix
+            term = srv.log.fetch_term(idx)
+            if term is None:
+                return
+            mac = srv.machine.which_module(srv.effective_machine_version)
+            srv.log.write_recovery_checkpoint(
+                SnapshotMeta(
+                    index=idx, term=term, cluster=tuple(srv.members()),
+                    machine_version=srv.effective_machine_version,
+                    live_indexes=tuple(mac.live_indexes(srv.machine_state)),
+                ),
+                srv.machine_state,
+            )
+        except Exception:  # noqa: BLE001 — best-effort: boot replays
+            pass
 
     def _on_actor_crash(self, actor) -> None:
         """Supervision: restart a crashed server proc (rest_for_one
         equivalent for the proc+worker pair)."""
         name = actor.name
         try:
-            self.restart_server(name)
+            # crashed state is suspect: no recovery checkpoint
+            self.restart_server(name, orderly=False)
         except Exception:  # noqa: BLE001
             traceback.print_exc()
 

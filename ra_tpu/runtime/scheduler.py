@@ -18,7 +18,10 @@ from typing import Any, Callable, List, Optional
 class Actor:
     """Mailbox + serialized batch handler."""
 
-    __slots__ = ("name", "on_batch", "_mailbox", "_lock", "_scheduled", "_sched", "alive")
+    __slots__ = (
+        "name", "on_batch", "_mailbox", "_lock", "_scheduled", "_sched",
+        "alive", "_idle",
+    )
 
     def __init__(self, name: str, on_batch: Callable[[List[Any]], None], sched: "Scheduler"):
         self.name = name
@@ -28,6 +31,8 @@ class Actor:
         self._scheduled = False
         self._sched = sched
         self.alive = True
+        self._idle = threading.Event()
+        self._idle.set()
 
     def send(self, msg: Any, front: bool = False) -> None:
         with self._lock:
@@ -46,10 +51,12 @@ class Actor:
             with self._lock:
                 if not self._mailbox or not self.alive:
                     self._scheduled = False
+                    self._idle.set()
                     return
                 batch = []
                 while self._mailbox and len(batch) < max_batch:
                     batch.append(self._mailbox.popleft())
+                self._idle.clear()
             try:
                 self.on_batch(batch)
             except Exception:  # noqa: BLE001 — actor crash isolation
@@ -59,12 +66,16 @@ class Actor:
                 self._sched.on_actor_crash(self)
                 with self._lock:
                     self._scheduled = False
+                    self._idle.set()
                 return
 
-    def kill(self) -> None:
+    def kill(self, quiesce_timeout: float = 5.0) -> None:
+        """Stop the actor; blocks until any in-flight batch handler has
+        finished, so callers may safely read the actor-owned state."""
         with self._lock:
             self.alive = False
             self._mailbox.clear()
+        self._idle.wait(quiesce_timeout)
 
 
 class Scheduler:

@@ -66,6 +66,8 @@ from ra_tpu.protocol import (
     FromPeer,
     HeartbeatReply,
     HeartbeatRpc,
+    InfoReply,
+    InfoRpc,
     InstallSnapshotAck,
     InstallSnapshotResult,
     InstallSnapshotRpc,
@@ -107,6 +109,9 @@ class PeerState:
     # not count for quorum/elections until promoted (reference:
     # maybe_promote_peer src/ra_server.erl:3977-3995)
     voter_status: Any = "voter"
+    # highest machine version the peer supports (None = unknown; learned
+    # from info/pre-vote rpcs) — gates upgrade strategies
+    machine_version: Optional[int] = None
 
     def is_voter(self) -> bool:
         return self.voter_status == "voter"
@@ -138,6 +143,10 @@ class ServerConfig:
     # when False.
     pre_vote: bool = True
     machine_config: Optional[Dict[str, Any]] = None
+    # "all" (default): bump the effective machine version only once every
+    # member supports it; "quorum": once a quorum does (reference:
+    # src/ra_server.erl:223-233)
+    machine_upgrade_strategy: str = "all"
 
 
 class Server:
@@ -285,7 +294,10 @@ class Server:
     def recover(self) -> None:
         """Replay the log up to the persisted last_applied, discarding
         effects (reference: ra_server:recover/1 src/ra_server.erl:469-528;
-        effects are not re-issued after restart, INTERNALS.md:91-106)."""
+        effects are not re-issued after restart, INTERNALS.md:91-106).
+        An orderly-shutdown recovery checkpoint, when present and valid,
+        replaces the replay prefix (reference:
+        maybe_recover_from_recovery_checkpoint :2769-2840)."""
         snap = self.log.snapshot_index_term()
         snap_idx = snap[0] if snap else 0
         self._scan_cluster_changes(snap_idx + 1)
@@ -294,6 +306,24 @@ class Server:
         # machine_state was recovered from the snapshot (or init): replay
         # starts right above it regardless of the persisted watermark
         self.last_applied = snap_idx
+        rc = self.log.read_recovery_checkpoint()
+        if rc is not None:
+            meta, state = rc
+            # single-use: a stale capture must never be replayed after a
+            # non-orderly restart, so consume it now regardless
+            self.log.discard_recovery_checkpoint()
+            # the orderly-shutdown capture itself proves entries up to
+            # meta.index were applied (hence committed) — it may be
+            # ahead of the async-persisted last_applied watermark
+            if (
+                snap_idx <= meta.index <= last_idx
+                and self.log.fetch_term(meta.index) == meta.term
+            ):
+                self.machine_state = state
+                self.effective_machine_version = meta.machine_version
+                self.last_applied = meta.index
+                target = max(target, meta.index)
+                self._c("recovery_checkpoint_used")
         self.commit_index = max(target, snap_idx)
         self._apply_to(self.commit_index, discard_effects=True)
 
@@ -328,6 +358,19 @@ class Server:
             and self.role != AWAIT_CONDITION
         ):
             return self._on_wal_down()
+        if isinstance(msg, InfoRpc):
+            # capability probe: answer from any role
+            if from_peer is None:
+                return []
+            return [SendRpc(from_peer, InfoReply(self.current_term, self.machine.version()))]
+        if isinstance(msg, InfoReply):
+            effects: EffectList = []
+            peer = self.cluster.get(from_peer)
+            if self.role == LEADER and peer is not None:
+                peer.machine_version = msg.machine_version
+                self._maybe_upgrade_machine(effects)
+                self._pipeline(effects)
+            return effects
         handler = {
             FOLLOWER: self._handle_follower,
             PRE_VOTE: self._handle_pre_vote,
@@ -399,8 +442,7 @@ class Server:
         # changes and (upgrade strategy permitting) bumps the machine
         # version (reference: post_election_effects src/ra_server.erl:
         # 4028-4064).
-        noop = Command(kind=NOOP, machine_version=max(self.machine_version,
-                                                     self.effective_machine_version))
+        noop = Command(kind=NOOP, machine_version=self._required_machine_version())
         self._append_leader(noop, effects)
         self._pipeline(effects)
 
@@ -669,8 +711,55 @@ class Server:
         # (reference: persist_last_applied src/ra_server.erl:2540-2567)
         self.meta.store(self.cfg.uid, "last_applied", self.last_applied)
         effects.extend(self.machine.tick(msg.now_ms, self.machine_state))
+        # probe peers whose supported machine version is unknown or
+        # below ours (rolling upgrades: a peer restarted with a newer
+        # machine must be re-discovered), and bump once the upgrade
+        # strategy's requirement is met. Probing stops once every peer
+        # reports >= our version.
+        own = self.machine.version()
+        for sid, p in self.peers().items():
+            if p.machine_version is None or (
+                p.machine_version < own
+                and self.effective_machine_version < own
+            ):
+                # re-probe lagging peers only while an upgrade is still
+                # pending locally (quorum-strategy clusters stop probing
+                # a legitimately-old minority once the bump lands)
+                effects.append(SendRpc(sid, InfoRpc(self.current_term, self.id)))
+        self._maybe_upgrade_machine(effects)
         self._pipeline(effects, force_commit_sync=True)
         return effects
+
+    def _required_machine_version(self) -> int:
+        """The version the upgrade strategy currently allows (never below
+        the effective version). Unknown peer versions count as
+        unsupporting (reference: src/ra_server.erl:223-233)."""
+        vers = []
+        for sid, p in self.cluster.items():
+            if sid == self.id:
+                vers.append(self.machine.version())
+            elif p.is_voter() or isinstance(p.voter_status, tuple):
+                vers.append(p.machine_version if p.machine_version is not None else -1)
+        if not vers:
+            return max(self.machine.version(), self.effective_machine_version)
+        if self.cfg.machine_upgrade_strategy == "quorum":
+            vers.sort(reverse=True)
+            need = len(vers) // 2 + 1
+            v = vers[need - 1]
+        else:  # "all"
+            v = min(vers)
+        return max(v, self.effective_machine_version)
+
+    def _maybe_upgrade_machine(self, effects: EffectList) -> None:
+        req = self._required_machine_version()
+        if req <= self.effective_machine_version or not self.cluster_change_permitted:
+            return
+        pending = getattr(self, "_upgrade_noop_idx", None)
+        if pending is not None and pending > self.last_applied:
+            return  # a bump noop is already in flight
+        idx = self.log.next_index()
+        self._append_leader(Command(kind=NOOP, machine_version=req), effects)
+        self._upgrade_noop_idx = idx
 
     def _leader_node_event(self, msg: Any, effects: EffectList) -> EffectList:
         if isinstance(msg, NodeEvent):
@@ -1118,6 +1207,11 @@ class Server:
         process_pre_vote for all roles too: src/ra_server.erl:2926-2984).
         Pre-vote is non-disruptive: no term change, no abdication — a
         genuinely ahead candidate dethrones us with its request_vote."""
+        # free capability discovery: the rpc carries the candidate's
+        # supported machine version
+        peer = self.cluster.get(from_peer)
+        if peer is not None:
+            peer.machine_version = max(peer.machine_version or 0, msg.machine_version)
         li, lt = self.log.last_index_term()
         granted = dec.pre_vote_decision(
             self.current_term,
