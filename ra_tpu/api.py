@@ -68,16 +68,70 @@ def stop_node(name: str) -> None:
         node.stop()
 
 
+def _mgmt_route(node_name: str):
+    """A callable mgmt transport for a node: local nodes are called
+    directly; remote nodes are reached over any local TCP transport
+    (reference: rpc:call management, src/ra_server_sup_sup.erl:33-50)."""
+    node = node_registry().get(node_name)
+    if node is not None:
+        return node
+    for local in node_registry().names():
+        n = node_registry().get(local)
+        t = getattr(n, "transport", None)
+        if t is not None and hasattr(t, "mgmt_call"):
+            return _RemoteNode(t, node_name)
+    raise RaError(f"no route to node {node_name!r} (no local TCP transport)")
+
+
+class _RemoteNode:
+    """Duck-typed remote management handle over TcpTransport.mgmt_call."""
+
+    def __init__(self, transport, node_name: str):
+        self._t = transport
+        self._node = node_name
+
+    def start_server(self, name, cluster_name, machine, members,
+                     machine_config=None, machine_factory=None, **_kw):
+        if machine is not None and machine_factory is None:
+            raise RaError(
+                "remote start_server requires machine_factory (machine "
+                "objects do not travel across nodes)"
+            )
+        return tuple(self._t.mgmt_call(self._node, "start_server", {
+            "name": name, "cluster_name": cluster_name, "members": members,
+            "machine_config": machine_config, "machine_factory": machine_factory,
+        }))
+
+    def restart_server(self, name, overrides=None, **_kw):
+        return tuple(self._t.mgmt_call(
+            self._node, "restart_server", {"name": name, "overrides": overrides}
+        ))
+
+    def stop_server(self, name, **_kw):
+        return self._t.mgmt_call(self._node, "stop_server", {"name": name})
+
+    def delete_server(self, name, **_kw):
+        return self._t.mgmt_call(self._node, "delete_server", {"name": name})
+
+    def trigger_election(self, name):
+        return self._t.mgmt_call(self._node, "trigger_election", {"name": name})
+
+    def overview(self):
+        return self._t.mgmt_call(self._node, "overview", {})
+
+
 def start_server(
     server_id: ServerId,
     cluster_name: str,
-    machine: Machine,
+    machine: Optional[Machine],
     members: Sequence[ServerId],
     machine_config: Optional[dict] = None,
+    machine_factory: Optional[str] = None,
 ) -> ServerId:
     name, node_name = server_id
-    return _node(node_name).start_server(
-        name, cluster_name, machine, tuple(members), machine_config=machine_config
+    return _mgmt_route(node_name).start_server(
+        name, cluster_name, machine, tuple(members),
+        machine_config=machine_config, machine_factory=machine_factory,
     )
 
 
@@ -105,25 +159,29 @@ def start_cluster(
 
 def delete_cluster(server_ids: Sequence[ServerId]) -> None:
     for name, node_name in server_ids:
-        node = node_registry().get(node_name)
-        if node is not None:
-            node.delete_server(name)
+        try:
+            _mgmt_route(node_name).delete_server(name)
+        except (RaError, RuntimeError, TimeoutError, OSError):
+            pass  # node gone entirely (or unreachable over mgmt)
 
 
-def restart_server(server_id: ServerId) -> ServerId:
+def restart_server(server_id: ServerId, overrides: Optional[dict] = None) -> ServerId:
     name, node_name = server_id
-    return _node(node_name).restart_server(name)
+    return _mgmt_route(node_name).restart_server(name, overrides=overrides)
 
 
 def stop_server(server_id: ServerId) -> None:
     name, node_name = server_id
-    _node(node_name).stop_server(name)
+    _mgmt_route(node_name).stop_server(name)
 
 
 def trigger_election(server_id: ServerId) -> None:
     name, node_name = server_id
-    node = _node(node_name)
-    proc = node.procs.get(name)
+    target = _mgmt_route(node_name)
+    if isinstance(target, _RemoteNode):
+        target.trigger_election(name)
+        return
+    proc = target.procs.get(name)
     if proc is None:
         raise RaError(f"server {server_id} not running")
     proc.enqueue(ElectionTimeout())
@@ -418,7 +476,7 @@ def aux_command(server_id: ServerId, cmd: Any, timeout: float = 5.0):
 
 
 def overview(node_name: str) -> dict:
-    return _node(node_name).overview()
+    return _mgmt_route(node_name).overview()
 
 
 def counters_overview() -> dict:

@@ -26,7 +26,7 @@ from ra_tpu.log.segment_writer import SegmentWriter
 from ra_tpu.log.tables import TableRegistry
 from ra_tpu.log.wal import Wal
 from ra_tpu.machine import Machine
-from ra_tpu.protocol import DownEvent, FromPeer, LogEvent, ServerId
+from ra_tpu.protocol import DownEvent, ElectionTimeout, FromPeer, LogEvent, ServerId
 from ra_tpu.runtime.proc import ServerProc
 from ra_tpu.runtime.scheduler import Scheduler
 from ra_tpu.runtime.timers import TimerService
@@ -122,6 +122,7 @@ class RaNode:
 
             self.transport = TcpTransport(name, self.deliver)
             self.transport.on_proc_down_cb = self.on_proc_down
+            self.transport.on_mgmt_cb = self._handle_mgmt
         else:
             self.transport = InProcTransport(name, self._registry)
         self.running = True
@@ -299,6 +300,31 @@ class RaNode:
             self.tables.delete_mem_table(uid)
             self.tables.delete_snapshot_state(uid)
             shutil.rmtree(os.path.join(self.dir, "data", uid), ignore_errors=True)
+
+    def _handle_mgmt(self, op: str, kw: dict):
+        """Remote management plane (reference: start_server_rpc /
+        restart_server_rpc / delete_server_rpc over rpc:call,
+        src/ra_server_sup_sup.erl:33-50). Remote starts must name a
+        machine_factory — machine objects do not travel."""
+        if op == "start_server":
+            return self.start_server(
+                kw["name"], kw["cluster_name"], None,
+                tuple(tuple(m) for m in kw["members"]),
+                machine_config=kw.get("machine_config"),
+                machine_factory=kw["machine_factory"],
+            )
+        if op == "restart_server":
+            return self.restart_server(kw["name"], overrides=kw.get("overrides"))
+        if op == "stop_server":
+            return self.stop_server(kw["name"])
+        if op == "delete_server":
+            return self.delete_server(kw["name"])
+        if op == "trigger_election":
+            self.deliver((kw["name"], self.name), ElectionTimeout(), None)
+            return None
+        if op == "overview":
+            return self.overview()
+        raise ValueError(f"unknown management op {op!r}")
 
     def _pre_init(self) -> None:
         """Register snapshot floors for every registered server BEFORE

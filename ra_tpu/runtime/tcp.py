@@ -100,6 +100,14 @@ class TcpTransport:
         # set by the owning node: called with a ServerId when a remote
         # peer announces one of its procs died
         self.on_proc_down_cb = None
+        # management plane (reference: rpc:call start/restart/delete on
+        # remote nodes, src/ra_server_sup_sup.erl:33-50): the owning
+        # node sets on_mgmt_cb(op, kwargs) -> result; mgmt_call() is the
+        # client side
+        self.on_mgmt_cb = None
+        self._mgmt_futs: Dict[int, Tuple[threading.Event, dict]] = {}
+        self._mgmt_seq = 0
+        self._mgmt_lock = threading.Lock()
         self._ping_thread = threading.Thread(
             target=self._ping_loop, name=f"ra-tcp-ping-{node_name}", daemon=True
         )
@@ -254,15 +262,41 @@ class TcpTransport:
                 self._enqueue_control(name, "__ping__")
             _t.sleep(self.ping_interval_s)
 
-    def _enqueue_control(self, node_name: str, kind: str, payload=None) -> None:
+    def _enqueue_control(self, node_name: str, kind: str, payload=None) -> bool:
         peer = self._peer(node_name)
         if peer is None:
-            return
+            return False  # unaddressable node name
         frame = self._seal(pickle.dumps((kind, self.node_name, payload)))
         with peer.cv:
-            if len(peer.outbox) < peer.cap:
-                peer.outbox.append(frame)
-                peer.cv.notify()
+            if len(peer.outbox) >= peer.cap:
+                return False
+            peer.outbox.append(frame)
+            peer.cv.notify()
+        return True
+
+    def mgmt_call(self, node_name: str, op: str, kwargs: dict, timeout: float = 10.0):
+        """Synchronous management RPC against a remote node (start /
+        restart / stop / delete server, overview). Raises on timeout or
+        remote error."""
+        with self._mgmt_lock:
+            self._mgmt_seq += 1
+            corr = self._mgmt_seq
+            ev, slot = threading.Event(), {}
+            self._mgmt_futs[corr] = (ev, slot)
+        try:
+            if not self._enqueue_control(node_name, "__mgmt__", (corr, op, kwargs)):
+                raise RuntimeError(
+                    f"mgmt {op}: node {node_name!r} unaddressable or outbox full"
+                )
+            if not ev.wait(timeout):
+                raise TimeoutError(f"mgmt {op} on {node_name} timed out")
+        finally:
+            with self._mgmt_lock:
+                self._mgmt_futs.pop(corr, None)
+        status, value = slot["r"]
+        if status != "ok":
+            raise RuntimeError(f"mgmt {op} on {node_name} failed: {value}")
+        return value
 
     def broadcast_proc_down(self, sid: ServerId) -> None:
         """Tell every connected peer that a local server proc died (the
@@ -316,6 +350,36 @@ class TcpTransport:
                         import time as _t
 
                         self._last_pong[from_sid] = _t.monotonic()
+                        continue
+                    if to_name == "__mgmt__":
+                        corr, op, kwargs = msg
+                        cb = self.on_mgmt_cb
+
+                        # off the receive thread: start/restart do WAL
+                        # recovery + disk I/O, which must not stall the
+                        # peer's Raft traffic on this connection
+                        def run_mgmt(corr=corr, op=op, kwargs=kwargs, frm=from_sid):
+                            try:
+                                r = (
+                                    ("ok", cb(op, kwargs))
+                                    if cb is not None
+                                    else ("error", "management not supported")
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                r = ("error", repr(e))
+                            self._enqueue_control(frm, "__mgmt_reply__", (corr, r))
+
+                        threading.Thread(
+                            target=run_mgmt, name="ra-tcp-mgmt", daemon=True
+                        ).start()
+                        continue
+                    if to_name == "__mgmt_reply__":
+                        corr, r = msg
+                        with self._mgmt_lock:
+                            fut = self._mgmt_futs.get(corr)
+                        if fut is not None:
+                            fut[1]["r"] = r
+                            fut[0].set()
                         continue
                     if to_name == "__proc_down__":
                         cb = self.on_proc_down_cb

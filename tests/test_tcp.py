@@ -220,3 +220,95 @@ def test_tcp_rejects_unauthenticated_frames():
             t3.close()
     finally:
         t.close()
+
+
+def _mgmt_counter_factory(config):
+    from ra_tpu.machine import SimpleMachine
+
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+_MGMT_WORKER = '''
+import sys, time
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+from ra_tpu import api
+from ra_tpu.system import SystemConfig
+
+port, data_dir = sys.argv[1], sys.argv[2]
+name = "127.0.0.1:" + port
+api.start_node(name, SystemConfig(name="mg", data_dir=data_dir),
+               election_timeout_s=0.15, tick_interval_s=0.1,
+               detector_poll_s=0.05, tcp=True)
+print("READY", flush=True)
+# idle until the parent is done managing us; report our server state
+from ra_tpu.runtime.transport import registry
+node = registry().get(name)
+deadline = time.time() + 60
+while time.time() < deadline:
+    p = node.procs.get("m0")
+    if p is not None and p.server.machine_state == 6:
+        print("REMOTE_STATE", p.server.machine_state, flush=True)
+        break
+    time.sleep(0.1)
+api.stop_node(name)
+'''
+
+
+def test_remote_management_over_tcp(tmp_path):
+    """A cluster on a REMOTE process is assembled and operated entirely
+    from this process via management RPCs (reference: rpc:call
+    start/restart/delete, src/ra_server_sup_sup.erl:33-50)."""
+    import os
+
+    from ra_tpu import api
+    from ra_tpu.system import SystemConfig
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    remote_port = free_port()
+    remote_name = f"127.0.0.1:{remote_port}"
+    local_name = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         _MGMT_WORKER.format(repo=repo, tests=tests),
+         str(remote_port), str(tmp_path / "remote")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        assert child.stdout.readline().strip() == "READY"
+        api.start_node(local_name, SystemConfig(name="mg", data_dir=str(tmp_path / "local")),
+                       election_timeout_s=0.15, tick_interval_s=0.1,
+                       detector_poll_s=0.05, tcp=True)
+        ids = [("m0", remote_name), ("m1", local_name)]
+        # start the REMOTE member first — purely via the management RPC
+        sid_remote = api.start_server(
+            ids[0], "mgc", None, ids,
+            machine_factory="test_tcp:_mgmt_counter_factory",
+        )
+        assert tuple(sid_remote) == ids[0]
+        api.start_server(ids[1], "mgc", None, ids,
+                         machine_factory="test_tcp:_mgmt_counter_factory")
+        api.trigger_election(ids[1])
+        # commands replicate across both processes
+        r, _ = api.process_command(ids[1], 1, timeout=20, retry_on_timeout=True)
+        r, _ = api.process_command(ids[1], 2, timeout=20, retry_on_timeout=True)
+        assert r == 3
+        # remote restart + overview over the management plane (before the
+        # final command: the child exits once it observes state 6)
+        restarted = api.restart_server(ids[0])
+        assert tuple(restarted) == ids[0]
+        ov = api.overview(remote_name)
+        assert ov["node"] == remote_name
+        r, _ = api.process_command(ids[1], 3, timeout=20, retry_on_timeout=True)
+        assert r == 6
+        out, err = child.communicate(timeout=60)
+        assert "REMOTE_STATE 6" in out, (out, err)
+    finally:
+        if child.poll() is None:
+            child.kill()
+        try:
+            api.stop_node(local_name)
+        except Exception:
+            pass
