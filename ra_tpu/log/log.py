@@ -44,6 +44,7 @@ class Log(LogApi):
         snapshot_store: Optional[SnapshotStore] = None,
         major_every_minors: int = 2,
         bg_submit=None,
+        segment_index_mode: str = "map",
     ):
         self.uid = uid
         self.server_dir = server_dir
@@ -51,7 +52,9 @@ class Log(LogApi):
         self.tables = tables
         self.wal = wal
         self.mt = tables.mem_table(uid)
-        self.segs = SegmentSet(os.path.join(server_dir, "segments"))
+        self.segs = SegmentSet(
+            os.path.join(server_dir, "segments"), index_mode=segment_index_mode
+        )
         self.snapshots = snapshot_store or SnapshotStore(server_dir)
         self.min_snapshot_interval = min_snapshot_interval
         self.min_checkpoint_interval = min_checkpoint_interval

@@ -20,6 +20,7 @@ Compaction tiers:
 
 from __future__ import annotations
 
+import bisect
 import os
 import pickle
 import threading
@@ -39,15 +40,23 @@ SYMLINK_KEEP_S = 60.0
 
 
 class SegmentSet:
-    def __init__(self, dir: str, open_cache: int = 8):
+    def __init__(self, dir: str, open_cache: int = 8, index_mode: str = "map"):
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
+        self.index_mode = index_mode  # "map" | "binary" (low-memory)
         self._lock = threading.RLock()
         # filename -> (lo, hi) inclusive range
         self.refs: Dict[str, Tuple[int, int]] = {}
         self._cache: FLRU[str, SegmentReader] = FLRU(
             open_cache, on_evict=lambda k, r: r.close()
         )
+        # interval index over refs for O(log n) point lookups (the
+        # reference keeps segment refs in a sorted ra_lol structure,
+        # src/ra_log_segments.erl:64-66): items sorted by lo, plus a
+        # prefix-max of hi so the left-walk prunes immediately
+        self._items: List[Tuple[int, int, str]] = []
+        self._los: List[int] = []
+        self._pmax: List[int] = []
         self._recover_compaction()
         for f in sorted(os.listdir(dir)):
             p = os.path.join(dir, f)
@@ -59,6 +68,18 @@ class SegmentSet:
                 if r.range:
                     self.refs[f] = r.range
                 r.close()
+        self._rebuild_interval_index()
+
+    def _rebuild_interval_index(self) -> None:
+        items = sorted((rng[0], rng[1], f) for f, rng in self.refs.items())
+        self._items = items
+        self._los = [it[0] for it in items]
+        pmax: List[int] = []
+        m = -1
+        for _lo, hi, _f in items:
+            m = max(m, hi)
+            pmax.append(m)
+        self._pmax = pmax
 
     def _recover_compaction(self) -> None:
         """Finish or roll back a major compaction interrupted by a crash
@@ -112,6 +133,7 @@ class SegmentSet:
         with self._lock:
             self.refs[fname] = rng
             self._cache.evict(fname)  # re-open to see new entries
+            self._rebuild_interval_index()
 
     def num_segments(self) -> int:
         return len(self.refs)
@@ -119,18 +141,27 @@ class SegmentSet:
     def _reader(self, fname: str) -> SegmentReader:
         r = self._cache.get(fname)
         if r is None:
-            r = SegmentReader(os.path.join(self.dir, fname))
+            r = SegmentReader(os.path.join(self.dir, fname), mode=self.index_mode)
             self._cache.insert(fname, r)
         return r
 
     def files_for(self, idx: int) -> List[str]:
         """Newest-first list of files whose range covers idx (later files
-        hold rewrites and win)."""
-        return [
-            f
-            for f in sorted(self.refs, reverse=True)
-            if self.refs[f][0] <= idx <= self.refs[f][1]
-        ]
+        hold rewrites and win). O(log n + matches) via the interval
+        index — the hot AER-construction read path must not scan every
+        segment ref."""
+        j = bisect.bisect_right(self._los, idx) - 1
+        out: List[str] = []
+        pmax = self._pmax
+        items = self._items
+        while j >= 0 and pmax[j] >= idx:
+            lo, hi, f = items[j]
+            if lo <= idx <= hi:
+                out.append(f)
+            j -= 1
+        if len(out) > 1:
+            out.sort(reverse=True)
+        return out
 
     # -- reads ------------------------------------------------------------
 
@@ -193,6 +224,7 @@ class SegmentSet:
                     # sparseness is the grouping signal — reference
                     # minor compaction likewise only deletes)
                     self._minor_compact(f, keep)
+            self._rebuild_interval_index()
         return removed
 
     def _minor_compact(self, fname: str, keep: Seq) -> None:
@@ -291,6 +323,7 @@ class SegmentSet:
 
             for grp in groups:
                 self._merge_group(grp, result)
+            self._rebuild_interval_index()
         return result
 
     def _merge_group(self, grp, result) -> None:

@@ -195,6 +195,7 @@ class RaNode:
                 min_snapshot_interval=self.config.min_snapshot_interval,
                 min_checkpoint_interval=self.config.min_checkpoint_interval,
                 bg_submit=self.bg.submit,  # major compaction off-thread
+                segment_index_mode=self.config.segment_index_mode,
             )
             extra = _extra_cfg or {}
             cfg = ServerConfig(

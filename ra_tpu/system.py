@@ -74,6 +74,11 @@ class SystemConfig:
     wal_compute_checksums: bool = True
     wal_sync_method: str = "datasync"  # datasync | sync | none
     segment_max_entries: int = SEGMENT_MAX_ENTRIES
+    # "map": parse segment indexes on open (fastest lookups);
+    # "binary": binary-search raw slots + read-ahead (low memory for
+    # sparse reads over many segments; reference index modes,
+    # src/ra_log_segment.erl:55-59)
+    segment_index_mode: str = "map"
     segment_max_size_bytes: int = SEGMENT_MAX_SIZE_BYTES
     segment_compute_checksums: bool = True
     snapshot_chunk_size: int = SNAPSHOT_CHUNK_SIZE
