@@ -515,12 +515,15 @@ class BatchCoordinator:
                 written[g.gid] = wi
             aer_dirty.add(g.gid)
             if g.pending_ack is not None and wi >= g.pending_ack[1]:
-                leader_sid = g.pending_ack[0]
+                leader_sid, cover = g.pending_ack
                 g.pending_ack = None
+                ack = min(wi, cover)
+                at = g.log.fetch_term(ack)
                 self._send_batch(
                     leader_sid[1],
                     [(leader_sid,
-                      AppendEntriesReply(g.term, True, wi + 1, wi, wt),
+                      AppendEntriesReply(g.term, True, ack + 1, ack,
+                                         at if at is not None else wt),
                       (g.name, self.name))],
                 )
             return
@@ -941,14 +944,19 @@ class BatchCoordinator:
                 pend.append(("w", g.gid, wi))
 
     def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, queue_send):
-        """Success ack with the host's durable watermark; deferred until
-        the WAL confirms when the write is still in flight."""
+        """Success ack with the host's durable watermark, anchored to
+        what THIS AER covered (a shorter-logged new leader must not see
+        acks above its own prev — mirrors the scalar backend); deferred
+        until the WAL confirms when the write is still in flight."""
         last_entry = msg.entries[-1].index if msg.entries else msg.prev_log_index
         wi, wt = g.log.last_written()
         if wi >= last_entry:
+            ack = min(wi, last_entry)
+            at = g.log.fetch_term(ack)
             queue_send(
                 from_sid,
-                AppendEntriesReply(term, True, wi + 1, wi, wt),
+                AppendEntriesReply(term, True, ack + 1, ack,
+                                   at if at is not None else wt),
                 (g.name, self.name),
             )
         else:

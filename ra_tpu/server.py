@@ -160,6 +160,9 @@ class Server:
         self.machine = cfg.machine
         self.role: str = FOLLOWER
         self.leader_id: Optional[ServerId] = None
+        # max index the current leader has confirmed holding (via its
+        # AERs); deferred written acks are anchored to it
+        self._leader_cover = 0
 
         self.current_term: int = meta.fetch(cfg.uid, "current_term", 0)
         self.voted_for: Optional[ServerId] = meta.fetch(cfg.uid, "voted_for", None)
@@ -1061,6 +1064,8 @@ class Server:
         self._update_term(msg.term)
         if self.leader_id != msg.leader_id:
             self.leader_id = msg.leader_id
+            # acks to a NEW leader may only cover what it has confirmed
+            self._leader_cover = 0
             effects.append(
                 RecordLeader(self.cfg.cluster_name, self.leader_id, tuple(self.members()))
             )
@@ -1090,16 +1095,25 @@ class Server:
             self.log.write(to_write)
             li, lt = self.log.last_index_term()
         self.commit_index = max(self.commit_index, min(msg.leader_commit, last_entry_idx))
-        # Reply only with the durable watermark; if writes are pending the
-        # reply happens on the written event (reference: src/ra_server.erl:
-        # 1457-1474 — replies carry the last fsynced index).
+        # Reply only with the durable watermark, anchored to what THIS
+        # AER covered: a new leader with a shorter log must not receive
+        # an ack above its own prev (reference follower_aer_5/6 — reply
+        # next_index = prev+n+1 even when our tail is longer). Deferred
+        # until the written event when writes are pending
+        # (src/ra_server.erl:1457-1474 — replies carry fsynced indexes).
+        self._leader_cover = max(getattr(self, "_leader_cover", 0), last_entry_idx)
         wi, wt = self.log.last_written()
         if wi >= last_entry_idx or not to_write:
+            ack = min(wi, last_entry_idx)
+            at = self.log.fetch_term(ack)
             self._c("aer_replies_success")
             effects.append(
                 SendRpc(
                     from_peer,
-                    AppendEntriesReply(self.current_term, True, wi + 1, wi, wt),
+                    AppendEntriesReply(
+                        self.current_term, True, ack + 1, ack,
+                        at if at is not None else wt,
+                    ),
                 )
             )
         # cluster changes take effect at append time
@@ -1142,12 +1156,23 @@ class Server:
     def _follower_send_written_reply(self, effects: EffectList) -> None:
         if self.leader_id is None or self.leader_id == self.id:
             return
+        # anchor to what the CURRENT leader has confirmed holding: a
+        # durable tail inherited from a previous leader must not inflate
+        # the new leader's match_index past its own log
+        cover = getattr(self, "_leader_cover", 0)
+        if cover <= 0:
+            return
         wi, wt = self.log.last_written()
+        ack = min(wi, cover)
+        at = self.log.fetch_term(ack)
         self._c("aer_replies_success")
         effects.append(
             SendRpc(
                 self.leader_id,
-                AppendEntriesReply(self.current_term, True, wi + 1, wi, wt),
+                AppendEntriesReply(
+                    self.current_term, True, ack + 1, ack,
+                    at if at is not None else wt,
+                ),
             )
         )
 
@@ -1314,6 +1339,8 @@ class Server:
             return effects
         if isinstance(msg, PreVoteRpc):
             return self._process_pre_vote(msg, from_peer, effects)
+        if isinstance(msg, HeartbeatRpc):
+            return self._nonfollower_heartbeat(msg, from_peer, effects)
         if isinstance(msg, ElectionTimeout):
             return self._call_for_pre_vote(effects)
         if isinstance(msg, LogEvent):
@@ -1379,6 +1406,8 @@ class Server:
                     SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
                 )
             return effects
+        if isinstance(msg, HeartbeatRpc):
+            return self._nonfollower_heartbeat(msg, from_peer, effects)
         if isinstance(msg, ElectionTimeout):
             return self._call_for_election(effects)
         if isinstance(msg, LogEvent):
@@ -1388,6 +1417,23 @@ class Server:
             if msg.from_ref is not None:
                 effects.append(Reply(msg.from_ref, ("redirect", self.leader_id)))
             return effects
+        return effects
+
+    def _nonfollower_heartbeat(
+        self, msg: HeartbeatRpc, from_peer: Optional[ServerId], effects: EffectList
+    ) -> EffectList:
+        """Heartbeats reaching a pre-vote/candidate server: a current-or-
+        higher term proves an elected leader (revert and re-dispatch); a
+        stale one gets our term back so the deposed leader steps down
+        (reference: pre_vote_heartbeat / candidate_heartbeat)."""
+        if msg.term >= self.current_term:
+            self._update_term(msg.term)
+            self._become_follower(effects, leader=msg.leader_id)
+            effects.append(NextEvent(FromPeer(from_peer, msg)))
+        else:
+            effects.append(
+                SendRpc(from_peer, HeartbeatReply(self.current_term, 0))
+            )
         return effects
 
     # ------------------------------------------------------------------
@@ -1454,9 +1500,20 @@ class Server:
             return effects
         if isinstance(msg, AppendEntriesRpc) and msg.term >= self.current_term:
             # leader moved on; abandon the transfer
+            self._update_term(msg.term)
             self._snap_accept = None
             self._become_follower(effects, leader=msg.leader_id)
             effects.append(NextEvent(FromPeer(from_peer, msg)))
+            return effects
+        if isinstance(msg, RequestVoteRpc):
+            # a higher-term election aborts the transfer (reference:
+            # receive_snapshot_request_vote_higher_term); stale votes
+            # must not (reference: ..._lower_term)
+            if msg.term > self.current_term:
+                self._update_term(msg.term)
+                self._snap_accept = None
+                self._become_follower(effects)
+                effects.append(NextEvent(FromPeer(from_peer, msg)))
             return effects
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
@@ -1522,6 +1579,10 @@ class Server:
             self._become_follower(effects)
             effects.append(NextEvent(FromPeer(from_peer, msg) if from_peer else msg))
             return effects
+        if isinstance(msg, PreVoteRpc):
+            # liveness: a waiting server must still answer pre-vote
+            # probes (reference: await_condition_receives_pre_vote)
+            return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
             return effects
