@@ -1,0 +1,455 @@
+"""Randomized KV consistency harness.
+
+The counterpart of the reference's shipped ``ra_kv_harness``
+(reference: ``src/ra_kv_harness.erl:21-35`` — a long-running loop of
+random put/get/delete, member add/remove, partitions and restarts
+against a reference map, with consistency-failure detection). Runs
+against either execution backend:
+
+- ``per_group_actor``: full fault mix — partitions, member restarts,
+  membership changes;
+- ``tpu_batch``: partitions + membership churn (coordinator restarts
+  are covered by the batch parity suite).
+
+Semantics: commands that time out MAY still have committed — the model
+tracks such keys as "uncertain" and accepts either outcome until the
+next successful write resolves them (the same at-least-once accounting
+the reference harness uses).
+
+Usage (tests call ``run`` directly; ops can run it standalone)::
+
+    result = run(seed=7, n_ops=300, backend="per_group_actor")
+    assert result.consistent, result.failures
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import Machine
+from ra_tpu.protocol import Command, ElectionTimeout, ServerId, USR
+from ra_tpu.runtime.transport import registry as node_registry
+from ra_tpu.system import SystemConfig
+
+
+class DictKv(Machine):
+    """Plain replicated map: ("put", k, v) | ("delete", k)."""
+
+    def init(self, config):
+        return {}
+
+    def apply(self, meta, cmd, state):
+        if isinstance(cmd, tuple) and cmd:
+            op = cmd[0]
+            if op == "put":
+                state = dict(state)
+                state[cmd[1]] = cmd[2]
+                return state, ("ok", cmd[2]), []
+            if op == "delete":
+                state = dict(state)
+                state.pop(cmd[1], None)
+                return state, ("ok", None), []
+        return state, None, []
+
+    def apply_many(self, meta, cmds, state):
+        state = dict(state)
+        for cmd in cmds:
+            if isinstance(cmd, tuple) and cmd:
+                if cmd[0] == "put":
+                    state[cmd[1]] = cmd[2]
+                elif cmd[0] == "delete":
+                    state.pop(cmd[1], None)
+        return state
+
+
+def _kv_factory(config):
+    return DictKv()
+
+
+@dataclasses.dataclass
+class HarnessResult:
+    consistent: bool
+    failures: List[str]
+    ops: Dict[str, int]
+    final_model: Dict[str, Any]
+
+
+def run(
+    seed: int = 0,
+    n_ops: int = 200,
+    backend: str = "per_group_actor",
+    nodes: int = 3,
+    data_dir: Optional[str] = None,
+    partitions: bool = True,
+    restarts: bool = True,
+    membership: bool = True,
+    op_timeout: float = 10.0,
+) -> HarnessResult:
+    if backend == "per_group_actor":
+        return _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
+                          membership, op_timeout)
+    if backend == "tpu_batch":
+        return _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+class _Model:
+    """Reference map with uncertainty tracking for timed-out writes."""
+
+    def __init__(self) -> None:
+        self.sure: Dict[str, Any] = {}
+        self.maybe: Dict[str, set] = {}  # key -> set of acceptable values
+        self.failures: List[str] = []
+
+    def applied(self, cmd) -> None:
+        k = cmd[1]
+        if cmd[0] == "put":
+            self.sure[k] = cmd[2]
+        else:
+            self.sure.pop(k, None)
+        self.maybe.pop(k, None)
+
+    def uncertain(self, cmd) -> None:
+        k = cmd[1]
+        cur = self.maybe.setdefault(
+            k, {self.sure[k]} if k in self.sure else {None}
+        )
+        cur.add(cmd[2] if cmd[0] == "put" else None)
+
+    def check_read(self, k, v, where: str) -> None:
+        if k in self.maybe:
+            # a stranded timed-out write may still commit later
+            # (at-least-once): the key stays uncertain until the next
+            # SUCCESSFUL write resolves it — a read must not pin it
+            ok = v in self.maybe[k]
+        else:
+            ok = self.sure.get(k) == v
+        if not ok:
+            self.failures.append(
+                f"{where}: key {k!r} read {v!r}, model "
+                f"{self.maybe.get(k, self.sure.get(k))!r}"
+            )
+
+    def check_state(self, state: Dict[str, Any], where: str) -> None:
+        keys = set(self.sure) | set(self.maybe) | set(state)
+        for k in keys:
+            self.check_read(k, state.get(k), where)
+
+
+def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
+               membership, op_timeout) -> HarnessResult:
+    import tempfile
+
+    from ra_tpu.machine import register_machine_factory
+
+    register_machine_factory("ra_tpu_kv_harness", _kv_factory)
+    rng = random.Random(seed)
+    base = data_dir or tempfile.mkdtemp(prefix="ra_kv_harness_")
+    names = [f"kvh{seed}_{i}" for i in range(nodes + 1)]  # +1 spare for joins
+    for n in names:
+        api.start_node(
+            n, SystemConfig(name=f"kvh{seed}", data_dir=f"{base}/{n}"),
+            election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
+        )
+    ids = [(f"kv{i}", names[i]) for i in range(nodes)]
+    spare = (f"kv{nodes}", names[nodes])
+    cluster = list(ids)
+    api.start_cluster(f"kvhc{seed}", DictKv, ids, timeout=20)
+    model = _Model()
+    counts: Dict[str, int] = {}
+    partitioned: Optional[str] = None
+
+    def heal():
+        nonlocal partitioned
+        for n in names:
+            node = node_registry().get(n)
+            if node is not None:
+                node.transport.unblock_all()
+        partitioned = None
+
+    consecutive_failures = [0]
+
+    def write(cmd):
+        try:
+            reply, _ = api.process_command(
+                rng.choice(cluster), cmd, timeout=op_timeout,
+                retry_on_timeout=True,
+            )
+            model.applied(cmd)
+            consecutive_failures[0] = 0
+        except Exception:  # noqa: BLE001 — may or may not have committed
+            model.uncertain(cmd)
+            consecutive_failures[0] += 1
+
+    try:
+        for op_i in range(n_ops):
+            if partitioned is not None and op_i % 20 == 19:
+                heal()  # bound leaderless stretches
+            if consecutive_failures[0] >= 4:
+                # operator action on a stuck deployment: heal and force
+                # an election (the final consistency checks still fail
+                # the run if service cannot be restored)
+                heal()
+                try:
+                    api.trigger_election(rng.choice(cluster))
+                except Exception:  # noqa: BLE001
+                    pass
+                consecutive_failures[0] = 0
+            roll = rng.random()
+            key = f"k{rng.randrange(12)}"
+            if roll < 0.45:
+                counts["put"] = counts.get("put", 0) + 1
+                write(("put", key, rng.randrange(1000)))
+            elif roll < 0.6:
+                counts["delete"] = counts.get("delete", 0) + 1
+                write(("delete", key))
+            elif roll < 0.8:
+                counts["get"] = counts.get("get", 0) + 1
+                try:
+                    out = api.consistent_query(
+                        rng.choice(cluster), lambda s: dict(s),
+                        timeout=op_timeout,
+                    )
+                    model.check_state(out[1], f"op{op_i} consistent_query")
+                except Exception:  # noqa: BLE001 — no leader right now
+                    pass
+            elif roll < 0.87 and partitions:
+                counts["partition"] = counts.get("partition", 0) + 1
+                if partitioned is None and rng.random() < 0.7:
+                    victim = rng.choice(cluster)[1]
+                    for n in names:
+                        if n != victim:
+                            a = node_registry().get(victim)
+                            b = node_registry().get(n)
+                            if a is not None:
+                                a.transport.block(victim, n)
+                            if b is not None:
+                                b.transport.block(n, victim)
+                    partitioned = victim
+                else:
+                    heal()
+            elif roll < 0.94 and restarts:
+                counts["restart"] = counts.get("restart", 0) + 1
+                sid = rng.choice(cluster)
+                if sid[1] != partitioned:
+                    try:
+                        api.restart_server(sid)
+                    except Exception:  # noqa: BLE001
+                        pass
+            elif membership and partitioned is None:
+                # membership changes only on a healed cluster: removing
+                # an alive member while another is partitioned away can
+                # drop below quorum and wedge until the next heal roll
+                counts["membership"] = counts.get("membership", 0) + 1
+                try:
+                    if spare in cluster and len(cluster) > 3:
+                        out = api.remove_member(cluster[0], spare,
+                                                timeout=op_timeout)
+                        if out[0] == "ok":
+                            node = node_registry().get(spare[1])
+                            if node is not None and spare[0] in node.procs:
+                                node.stop_server(spare[0])
+                            cluster.remove(spare)
+                    elif spare not in cluster:
+                        api.start_server(
+                            spare, f"kvhc{seed}", None, cluster + [spare],
+                            machine_factory="ra_tpu_kv_harness",
+                        )
+                        out = api.add_member(cluster[0], spare,
+                                             timeout=op_timeout)
+                        if out[0] == "ok":
+                            cluster.append(spare)
+                except Exception:  # noqa: BLE001 — change may be rejected
+                    pass
+
+        heal()
+        # quiesce, then every replica must converge to the model
+        final = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                out = api.consistent_query(cluster[0], lambda s: dict(s),
+                                           timeout=op_timeout)
+                final = out[1]
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        if final is None:
+            model.failures.append("no leader after heal: cluster wedged")
+        else:
+            model.check_state(final, "final consistent read")
+            deadline = time.monotonic() + 30
+            laggards = list(cluster)
+            while time.monotonic() < deadline and laggards:
+                still = []
+                for sid in laggards:
+                    try:
+                        v = api.local_query(sid, lambda s: dict(s))[1]
+                        if v != final:
+                            still.append(sid)
+                    except Exception:  # noqa: BLE001
+                        still.append(sid)
+                laggards = still
+                if laggards:
+                    time.sleep(0.2)
+            for sid in laggards:
+                model.failures.append(f"replica {sid} never converged")
+    finally:
+        for n in names:
+            try:
+                api.stop_node(n)
+            except Exception:  # noqa: BLE001
+                pass
+        leaderboard.clear()
+    return HarnessResult(
+        consistent=not model.failures, failures=model.failures,
+        ops=counts, final_model=dict(model.sure),
+    )
+
+
+def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> HarnessResult:
+    from ra_tpu.ops import consensus as C
+    from ra_tpu.runtime.coordinator import BatchCoordinator
+
+    rng = random.Random(seed)
+    names = [f"kvb{seed}_{i}" for i in range(nodes + 1)]  # +1 spare for joins
+    coords = {}
+    for n in names:
+        c = BatchCoordinator(n, capacity=8, num_peers=nodes + 1)
+        coords[n] = c
+        c.start()
+    gname = "kvbg0"
+    cluster = [(gname, n) for n in names[:nodes]]
+    spare = (gname, names[nodes])
+    for _, n in cluster:
+        coords[n].add_group(gname, f"kvbc{seed}", cluster, DictKv())
+    coords[names[0]].deliver((gname, names[0]), ElectionTimeout(), None)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not any(
+        coords[n].by_name[gname].role == C.R_LEADER for _, n in cluster
+    ):
+        time.sleep(0.05)
+    model = _Model()
+    counts: Dict[str, int] = {}
+    partitioned: Optional[str] = None
+
+    def heal():
+        nonlocal partitioned
+        for c in coords.values():
+            c.transport.unblock_all()
+        partitioned = None
+
+    def write(cmd):
+        try:
+            reply, _ = api.process_command(
+                rng.choice(cluster), cmd, timeout=op_timeout,
+                retry_on_timeout=True,
+            )
+            model.applied(cmd)
+        except Exception:  # noqa: BLE001
+            model.uncertain(cmd)
+
+    try:
+        for op_i in range(n_ops):
+            roll = rng.random()
+            key = f"k{rng.randrange(12)}"
+            if roll < 0.5:
+                counts["put"] = counts.get("put", 0) + 1
+                write(("put", key, rng.randrange(1000)))
+            elif roll < 0.65:
+                counts["delete"] = counts.get("delete", 0) + 1
+                write(("delete", key))
+            elif roll < 0.85:
+                counts["get"] = counts.get("get", 0) + 1
+                try:
+                    out = api.consistent_query(
+                        rng.choice(cluster), lambda s: dict(s),
+                        timeout=op_timeout,
+                    )
+                    model.check_state(out[1], f"op{op_i} consistent_query")
+                except Exception:  # noqa: BLE001
+                    pass
+            elif roll < 0.93 and partitions:
+                counts["partition"] = counts.get("partition", 0) + 1
+                if partitioned is None and rng.random() < 0.7:
+                    victim = rng.choice([n for _, n in cluster])
+                    for n in names:
+                        if n != victim:
+                            coords[victim].transport.block(victim, n)
+                            coords[n].transport.block(n, victim)
+                    partitioned = victim
+                else:
+                    heal()
+            elif membership and partitioned is None:
+                counts["membership"] = counts.get("membership", 0) + 1
+                try:
+                    if spare in cluster:
+                        out = api.remove_member(cluster[0], spare,
+                                                timeout=op_timeout)
+                        if out[0] == "ok":
+                            cluster.remove(spare)
+                    else:
+                        coords[spare[1]].add_group(
+                            gname, f"kvbc{seed}", cluster + [spare], DictKv()
+                        )
+                        out = api.add_member(cluster[0], spare,
+                                             timeout=op_timeout)
+                        if out[0] == "ok":
+                            cluster.append(spare)
+                except Exception:  # noqa: BLE001 — change may be rejected
+                    pass
+
+        heal()
+        final = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                out = api.consistent_query(cluster[0], lambda s: dict(s),
+                                           timeout=op_timeout)
+                final = out[1]
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        if final is None:
+            model.failures.append("no leader after heal: cluster wedged")
+        else:
+            model.check_state(final, "final consistent read")
+            deadline = time.monotonic() + 30
+            laggards = [n for _, n in cluster]  # current members only
+            while time.monotonic() < deadline and laggards:
+                laggards = [
+                    n for n in laggards
+                    if coords[n].by_name[gname].machine_state != final
+                ]
+                if laggards:
+                    time.sleep(0.2)
+            for n in laggards:
+                model.failures.append(f"replica {n} never converged")
+    finally:
+        for c in coords.values():
+            c.stop()
+        leaderboard.clear()
+    return HarnessResult(
+        consistent=not model.failures, failures=model.failures,
+        ops=counts, final_model=dict(model.sure),
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover — ops entry point
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ops", type=int, default=500)
+    ap.add_argument("--backend", default="per_group_actor")
+    args = ap.parse_args()
+    res = run(seed=args.seed, n_ops=args.ops, backend=args.backend)
+    print(f"ops={res.ops} consistent={res.consistent}")
+    for f in res.failures:
+        print("FAILURE:", f)
+    sys.exit(0 if res.consistent else 1)

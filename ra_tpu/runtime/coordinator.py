@@ -104,6 +104,7 @@ class GroupHost:
         "voter_status", "cluster_change_permitted", "cluster_index",
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
+        "last_ack",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -166,6 +167,9 @@ class GroupHost:
         # when a new leader truncates that suffix.
         # [(entry_index, members_copy, voter_status_copy), ...]
         self.cluster_history: List[Tuple[int, List, Dict[int, Any]]] = []
+        # per-slot monotonic time of the last AER ack (leader-side);
+        # drives the periodic resync of silent peers
+        self.last_ack: Dict[int, float] = {}
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -479,6 +483,7 @@ class BatchCoordinator:
             if isinstance(msg, AppendEntriesReply) and g.role == C.R_LEADER:
                 slot = g.slot_of(from_sid)
                 if slot >= 0:
+                    g.last_ack[slot] = time.monotonic()
                     if msg.success:
                         g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
                         vs = g.voter_status.get(slot)
@@ -610,6 +615,7 @@ class BatchCoordinator:
     def _alloc_slot(self, g: GroupHost) -> Optional[int]:
         for i, m in enumerate(g.members):
             if m is None:
+                g.last_ack.pop(i, None)  # fresh occupant, fresh liveness
                 return i  # reuse a tombstoned slot
         if len(g.members) < self.P:
             g.members.append(None)
@@ -966,6 +972,7 @@ class BatchCoordinator:
         li, _ = g.log.last_index_term()
         g.next_index = [li + 1] * len(g.members)
         g.commit_sent = [0] * len(g.members)
+        g.last_ack = {}
         g.leader_slot = g.self_slot
         leaderboard.record(g.cluster_name, (g.name, self.name), tuple(g.members))
         # the new term's noop (commit gate + version carrier)
@@ -1298,6 +1305,17 @@ class BatchCoordinator:
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "resync":
+            if g.role == C.R_LEADER:
+                now = time.monotonic()
+                for s in msg[1]:
+                    if s < len(g.commit_sent):
+                        # -1 sentinel: the probe must fire even at
+                        # commit 0 (a fresh leader's lost noop AER)
+                        g.commit_sent[s] = -1
+                        g.last_ack.setdefault(s, now)
+                self._send_aers({g.gid})
+            return
         if isinstance(msg, tuple) and msg and msg[0] == "machine_tick":
             mac = g.machine.which_module(g.effective_machine_version)
             effs = mac.tick(msg[1], g.machine_state)
@@ -1340,6 +1358,7 @@ class BatchCoordinator:
             g.next_index = [idx + 1]
             g.commit_sent = [0]
             g.voter_status = {0: "voter"}
+            g.last_ack = {}
             g.cluster_change_permitted = True
             onehot = np.zeros(self.P, dtype=bool)
             onehot[0] = True
@@ -1516,6 +1535,7 @@ class BatchCoordinator:
                 g.voter_status = {i: "voter" for i in range(len(new))}
                 g.next_index = [meta.index + 1] * len(new)
                 g.commit_sent = [0] * len(new)
+                g.last_ack = {}
                 self.state = self.state._replace(
                     self_slot=self.state.self_slot.at[g.gid].set(g.self_slot)
                 )
@@ -1589,8 +1609,26 @@ class BatchCoordinator:
                     ms = int(time.time() * 1000)
                     for i in range(self.n_groups):
                         g = self.groups[i]
-                        if g is not None and g.has_tick:
+                        if g is None:
+                            continue
+                        if g.has_tick:
                             self.deliver((g.name, self.name), ("machine_tick", ms), None)
+                        if g.role == C.R_LEADER:
+                            # peers silent for two ticks may have missed
+                            # AERs (drops/partitions advance next_index
+                            # optimistically): probe them so their reject
+                            # hints rewind replication (zero cost while
+                            # acks flow)
+                            stale = [
+                                s for s, m in enumerate(g.members)
+                                if m is not None and s != g.self_slot
+                                and now0 - g.last_ack.get(s, 0.0)
+                                > 2 * self.tick_interval_s
+                            ]
+                            if stale:
+                                self.deliver(
+                                    (g.name, self.name), ("resync", stale), None
+                                )
                 # a stopped node unregisters: include previously-seen
                 # names so disappearance reads as death
                 known = set(self.registry.names()) | set(self._node_status)

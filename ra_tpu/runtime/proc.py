@@ -204,6 +204,18 @@ class ServerProc:
         self._machine_timers: Dict[Any, int] = {}
         self.running = True
         self._set_tick_timer()
+        # a server that starts without evidence of a LIVE leader must arm
+        # an election timer, or a restarted ex-leader (leader_id == self,
+        # excluded from every suspicion check) wedges the whole cluster:
+        # the behind followers lose pre-votes against its longer log and
+        # IT never stands (reference: servers arm a state timeout on
+        # entering follower after recovery). First AER contact disarms.
+        if (
+            server.role == FOLLOWER
+            and server.is_voter_self()
+            and (server.leader_id is None or server.leader_id == server.id)
+        ):
+            self.arm_election_timer()
         self._update_state_table()
 
     # ------------------------------------------------------------------
