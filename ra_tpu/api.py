@@ -289,12 +289,15 @@ def _cluster_of(sid: ServerId) -> Optional[str]:
 
 
 def pipeline_command(
-    server_id: ServerId, data: Any, correlation: Any, who: Any
+    server_id: ServerId, data: Any, correlation: Any, who: Any,
+    priority: str = "normal",
 ) -> bool:
     """Async command: the applied notification arrives on the client sink
     registered as ``who`` (reference: ra:pipeline_command + {applied,
-    Corrs} ra_events)."""
-    cmd = Command(kind=USR, data=data, reply_mode=("notify", correlation, who))
+    Corrs} ra_events). ``priority="low"`` buffers the command behind
+    normal traffic, drained in bounded slices."""
+    cmd = Command(kind=USR, data=data, reply_mode=("notify", correlation, who),
+                  priority=priority)
     return _try_send(server_id, cmd)
 
 
@@ -463,6 +466,31 @@ def read_entries(server_id: ServerId, indexes, timeout: float = 5.0):
     ):
         raise RaError(f"server {server_id} unreachable")
     return fut.result(timeout)[1]
+
+
+def read_plan(server_id: ServerId, indexes, timeout: float = 5.0):
+    """Capture a ReadPlan from the server (a tiny in-proc query), to be
+    EXECUTED by the caller outside the server process (reference:
+    ra_log_read_plan.erl:10-31 — partial_read in-proc, exec_read_plan
+    external). Use ``plan.execute()`` (or ``exec_read_plan``) on any
+    thread; the consensus path is never blocked by the reads."""
+    from ra_tpu.log.read_plan import ReadPlan
+
+    idxs = tuple(indexes)
+    fut = Future()
+
+    def capture(s):
+        return (s.cfg.uid, getattr(s.log, "server_dir", ""))
+
+    if not _try_send(server_id, ("state_query", capture, fut)):
+        raise RaError(f"server {server_id} unreachable")
+    uid, server_dir = fut.result(timeout)[1]
+    return ReadPlan(uid=uid, node_name=server_id[1], server_dir=server_dir,
+                    indexes=idxs)
+
+
+# caller-side plan execution (one definition, re-exported)
+from ra_tpu.log.read_plan import exec_read_plan  # noqa: E402,F401
 
 
 def aux_command(server_id: ServerId, cmd: Any, timeout: float = 5.0):

@@ -45,6 +45,7 @@ class Log(LogApi):
         major_every_minors: int = 2,
         bg_submit=None,
         segment_index_mode: str = "map",
+        sync_pool=None,
     ):
         self.uid = uid
         self.server_dir = server_dir
@@ -55,7 +56,7 @@ class Log(LogApi):
         self.segs = SegmentSet(
             os.path.join(server_dir, "segments"), index_mode=segment_index_mode
         )
-        self.snapshots = snapshot_store or SnapshotStore(server_dir)
+        self.snapshots = snapshot_store or SnapshotStore(server_dir, sync_pool=sync_pool)
         self.min_snapshot_interval = min_snapshot_interval
         self.min_checkpoint_interval = min_checkpoint_interval
         # major compaction policy: schedule a grouping pass every N

@@ -45,7 +45,11 @@ class SnapshotCodec:
 
     name = "pickle"
 
-    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any) -> None:
+    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any,
+              sync_pool=None) -> None:
+        """Write the capture under ``dir``. When a SyncPool is given the
+        codec routes its fsyncs through it (serialized across servers,
+        reference: ra_log_sync); durability on return is unchanged."""
         raise NotImplementedError
 
     def read(self, dir: str) -> Tuple[SnapshotMeta, Any]:
@@ -63,13 +67,16 @@ class PickleCodec(SnapshotCodec):
     ``snapshot.dat``)."""
 
     @staticmethod
-    def _write_file(path: str, obj: Any) -> None:
+    def _write_file(path: str, obj: Any, sync_pool=None) -> None:
         payload = pickle.dumps(obj)
         with open(path, "wb") as f:
             f.write(payload)
             f.write(_TRAILER.pack(zlib.crc32(payload)))
             f.flush()
-            os.fsync(f.fileno())
+            if sync_pool is None:
+                os.fsync(f.fileno())
+        if sync_pool is not None:
+            sync_pool.sync_path(path)
 
     @staticmethod
     def _read_file(path: str) -> Any:
@@ -81,9 +88,10 @@ class PickleCodec(SnapshotCodec):
             raise IOError(f"snapshot crc mismatch: {path}")
         return pickle.loads(payload)
 
-    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any) -> None:
-        self._write_file(os.path.join(dir, "meta.dat"), meta)
-        self._write_file(os.path.join(dir, "snapshot.dat"), machine_state)
+    def write(self, dir: str, meta: SnapshotMeta, machine_state: Any,
+              sync_pool=None) -> None:
+        self._write_file(os.path.join(dir, "meta.dat"), meta, sync_pool)
+        self._write_file(os.path.join(dir, "snapshot.dat"), machine_state, sync_pool)
 
     def read(self, dir: str) -> Tuple[SnapshotMeta, Any]:
         return (
@@ -106,10 +114,11 @@ class SnapshotStore:
     """Per-server snapshot/checkpoint directory manager."""
 
     def __init__(self, server_dir: str, codec: Optional[SnapshotCodec] = None,
-                 max_checkpoints: int = 10):
+                 max_checkpoints: int = 10, sync_pool=None):
         self.server_dir = server_dir
         self.codec = codec or PickleCodec()
         self.max_checkpoints = max_checkpoints
+        self.sync_pool = sync_pool
         for kind in (SNAPSHOT, CHECKPOINT, RECOVERY):
             os.makedirs(os.path.join(server_dir, kind), exist_ok=True)
 
@@ -152,7 +161,7 @@ class SnapshotStore:
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-        self.codec.write(tmp, meta, machine_state)
+        self.codec.write(tmp, meta, machine_state, sync_pool=self.sync_pool)
         os.replace(tmp, final)
         sync_dir(d)
         if kind == SNAPSHOT:

@@ -1,0 +1,93 @@
+"""Pooled fsync workers.
+
+The counterpart of the reference's ``ra_log_sync`` (reference:
+``src/ra_log_sync.erl:32-35`` — a pool of batching fsync workers, sized
+schedulers/4, serializing snapshot-directory syncs across servers so a
+burst of snapshot writes cannot issue an fsync storm against the
+device). Callers block until their sync lands (durability semantics
+unchanged); the pool bounds CONCURRENCY and batches same-path requests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class SyncPool:
+    def __init__(self, workers: Optional[int] = None):
+        n = workers or max(1, (os.cpu_count() or 1) // 4)
+        self._cv = threading.Condition()
+        self._queue: deque = deque()  # (path, Event, err_slot)
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._run, name=f"ra-sync-{i}", daemon=True)
+            for i in range(n)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def sync_path(self, path: str, timeout: Optional[float] = None) -> None:
+        """fsync the file (or directory) at ``path`` via the pool;
+        blocks until durable — like the inline os.fsync it replaces, a
+        slow device makes this SLOWER, never a spurious failure (pass a
+        timeout only where the caller can handle TimeoutError). Raises
+        the worker's OSError on failure."""
+        done = threading.Event()
+        slot: Dict[str, BaseException] = {}
+        with self._cv:
+            if self._closed:
+                # closed pool: sync inline so durability never silently
+                # degrades
+                self._fsync(path)
+                return
+            self._queue.append((path, done, slot))
+            self._cv.notify()
+        if not done.wait(timeout):
+            raise TimeoutError(f"sync of {path!r} timed out")
+        err = slot.get("err")
+        if err is not None:
+            raise err
+
+    @staticmethod
+    def _fsync(path: str) -> None:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(timeout=0.5)
+                if self._closed and not self._queue:
+                    return
+                path, done, slot = self._queue.popleft()
+                # batch: everyone queued behind us for the SAME path is
+                # satisfied by this one fsync
+                extra: List = []
+                rest: deque = deque()
+                while self._queue:
+                    item = self._queue.popleft()
+                    (extra if item[0] == path else rest).append(item)
+                self._queue = rest
+            try:
+                self._fsync(path)
+                err = None
+            except OSError as e:
+                err = e
+            for _p, d, s in [(path, done, slot)] + extra:
+                if err is not None:
+                    s["err"] = err
+                d.set()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -49,6 +49,10 @@ class Command(NamedTuple):
     # caller ref for synchronous replies (opaque to the core)
     from_ref: Any = None
     machine_version: int = 0  # only meaningful for NOOP
+    # "normal" | "low": low-priority commands are buffered behind normal
+    # traffic and drained in bounded slices (reference: ra_ets_queue +
+    # FLUSH_COMMANDS_SIZE, src/ra_server_proc.erl:160,507-530)
+    priority: str = "normal"
 
 
 # -- snapshot metadata -----------------------------------------------------

@@ -92,6 +92,9 @@ class RaNode:
         # THEN WAL recovery runs — so recovery can skip dead indexes
         # instead of resurrecting them (reference:
         # src/ra_log_pre_init.erl:31-45, src/ra_log_sup.erl:20-63)
+        from ra_tpu.log.sync_pool import SyncPool
+
+        self.sync_pool = SyncPool()  # serialized snapshot fsyncs (ra_log_sync)
         self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
         self.directory = Directory(self.meta)
         self._pre_init()
@@ -196,6 +199,7 @@ class RaNode:
                 min_checkpoint_interval=self.config.min_checkpoint_interval,
                 bg_submit=self.bg.submit,  # major compaction off-thread
                 segment_index_mode=self.config.segment_index_mode,
+                sync_pool=self.sync_pool,
             )
             extra = _extra_cfg or {}
             cfg = ServerConfig(
@@ -572,6 +576,7 @@ class RaNode:
             self.stop_server(name)
         self.wal.close()
         self.sw.close()
+        self.sync_pool.close()
         self.meta.close()
         self.scheduler.close()
         self.timers.close()

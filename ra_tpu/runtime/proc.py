@@ -202,6 +202,10 @@ class ServerProc:
         self._last_commit_sample = (time.monotonic(), server.commit_index)
         self._senders: Dict[ServerId, SnapshotSender] = {}
         self._machine_timers: Dict[Any, int] = {}
+        # buffered low-priority commands (reference: ra_ets_queue)
+        from collections import deque as _deque
+
+        self._low_q = _deque()
         self.running = True
         self._set_tick_timer()
         # a server that starts without evidence of a LIVE leader must arm
@@ -231,19 +235,36 @@ class ServerProc:
 
     # ------------------------------------------------------------------
 
+    # max low-priority commands appended per drain (reference:
+    # ?FLUSH_COMMANDS_SIZE, src/ra_server.hrl:34)
+    FLUSH_COMMANDS_SIZE = 16
+
     def _on_batch(self, batch: List[Any]) -> None:
         server = self.server
         i = 0
         n = len(batch)
         while i < n:
             msg = batch[i]
-            # coalesce consecutive client commands into one core call
+            # coalesce consecutive client commands into one core call;
+            # low-priority commands are set aside and drained in bounded
+            # slices after normal traffic (reference: ra_ets_queue lane,
+            # src/ra_server_proc.erl:507-530)
             if isinstance(msg, Command) and server.role == LEADER:
                 cmds = [msg]
                 while i + 1 < n and isinstance(batch[i + 1], Command):
                     i += 1
                     cmds.append(batch[i])
-                effects = server.handle(cmds if len(cmds) > 1 else cmds[0])
+                low = [c for c in cmds if c.priority == "low"]
+                if low:
+                    self._low_q.extend(low)
+                    cmds = [c for c in cmds if c.priority != "low"]
+                effects = (
+                    server.handle(cmds if len(cmds) > 1 else cmds[0])
+                    if cmds
+                    else []
+                )
+            elif isinstance(msg, tuple) and msg and msg[0] == "flush_low":
+                effects = []  # drain happens below once per batch
             elif isinstance(msg, tuple) and msg and msg[0] in (
                 "snapshot_send_done",
                 "snapshot_send_failed",
@@ -281,6 +302,17 @@ class ServerProc:
                 effects = server.handle(msg)
             self._execute(effects)
             i += 1
+        if self._low_q and server.role == LEADER:
+            take = [
+                self._low_q.popleft()
+                for _ in range(min(self.FLUSH_COMMANDS_SIZE, len(self._low_q)))
+            ]
+            self._execute(server.handle(take if len(take) > 1 else take[0]))
+            if self._low_q:
+                # keep the actor hot until the lane drains (dedicated
+                # sentinel: a synthetic Tick would run the full leader
+                # tick and skew the commit-rate gauge per slice)
+                self.enqueue(("flush_low",))
         self._update_state_table()
 
     def _note_contact(self, msg: FromPeer) -> None:
@@ -432,6 +464,13 @@ class ServerProc:
             self.enqueue(ElectionTimeout())
 
     def _on_state_enter(self, role: str) -> None:
+        if role != LEADER and self._low_q:
+            # leadership lost with lows still buffered: drop them —
+            # replaying them under a later term would double-apply
+            # commands the client already resent to the new leader
+            # (pipeline commands are at-most-once; clients track
+            # correlations)
+            self._low_q.clear()
         if role in (PRE_VOTE, CANDIDATE):
             self.arm_election_timer()  # retry a stalled election round
         elif role == "await_condition":
