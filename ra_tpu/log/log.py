@@ -100,8 +100,8 @@ class Log(LogApi):
             raise ValueError(
                 f"non-contiguous append {entry.index} after {self._last_index}"
             )
-        self.mt.insert(entry)
-        self.wal.write(self.uid, entry.index, entry.term, encode_cmd(entry.cmd))
+        tid = self.mt.insert(entry)
+        self.wal.write(self.uid, entry.index, entry.term, encode_cmd(entry.cmd), tid=tid)
         self._last_index = entry.index
         self._last_term = entry.term
 
@@ -117,16 +117,17 @@ class Log(LogApi):
             self.mt.truncate_from(first)
             self._rewind_to(first - 1)
         for e in entries:
-            self.mt.insert(e)
-            self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
+            tid = self.mt.insert(e)
+            self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd), tid=tid)
         self._last_index = entries[-1].index
         self._last_term = entries[-1].term
 
     def write_sparse(self, entry: Entry) -> None:
         """Out-of-order live-entry write during snapshot install."""
-        self.mt.insert_sparse(entry)
+        tid = self.mt.insert_sparse(entry)
         self.wal.write(
-            self.uid, entry.index, entry.term, encode_cmd(entry.cmd), sparse=True
+            self.uid, entry.index, entry.term, encode_cmd(entry.cmd),
+            sparse=True, tid=tid,
         )
 
     def set_last_index(self, idx: int) -> None:
@@ -163,10 +164,11 @@ class Log(LogApi):
                 self._written_term = term
             return []
         if tag == "segments":
-            _, seq, refs = evt
+            _, tid_seqs, refs = evt
             for fname, rng in refs:
                 self.segs.add_ref(fname, rng)
-            self.mt.record_flushed(seq)
+            for tid, seq in tid_seqs:
+                self.mt.record_flushed(seq, tid=tid)
             return []
         if tag == "resend_write":
             # throttled: a flood of gap notifications must not re-queue
@@ -195,9 +197,15 @@ class Log(LogApi):
             # the fresh file before replaying the current tail
             self.wal.truncate_write(self.uid, from_idx)
         for i in range(from_idx, self._last_index + 1):
-            e = self.mt.get(i)
-            if e is not None:
-                self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd))
+            got = self.mt.get_with_tid(i)
+            if got is not None:
+                e, tid = got
+                # tag with the table that OWNS the entry: tagging an
+                # older table's entry with the head tid would make the
+                # eventual flush read get_from(head, i) -> None and
+                # silently drop the only durable copy
+                self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd),
+                               tid=tid)
 
     # ------------------------------------------------------------------
     # reads

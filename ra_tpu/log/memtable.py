@@ -1,11 +1,20 @@
 """Per-server in-memory table of recent log entries.
 
 Plays the role of the reference's ETS-backed memtables (reference:
-``src/ra_mt.erl`` — strictly-monotone inserts, flush-driven deletion,
-range tracking), re-designed as a plain dict + range bookkeeping owned by
-the runtime's table registry (``ra_tpu.log.tables``). Entries live here
-from the moment they are appended until the segment writer has flushed
-them to disk; reads always prefer the memtable.
+``src/ra_mt.erl`` — strictly-monotone inserts within one table,
+**successor chaining** on overwrite or size rotation :86-225,
+flush-driven deletion :439, range tracking). Entries live here from the
+moment they are appended until the segment writer has flushed them.
+
+Why chains matter: the segment writer flushes a rolled WAL file's
+entries concurrently with the server possibly overwriting a divergent
+suffix. Entries are therefore **never overwritten in place** (the
+reference's core invariant, docs/internals/LOG.md:82-96): an overwrite
+(or a table exceeding ``max_entries``) starts a successor table; the
+old table keeps its entries — identified by table id — until the flush
+that references them completes. Reads serve the *visible* view (newest
+table first, truncations applied); flush reads address an exact table
+id and see exactly what the WAL file contained.
 """
 
 from __future__ import annotations
@@ -15,59 +24,156 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ra_tpu.protocol import Entry
 from ra_tpu.utils.seq import Seq
 
+# rotation threshold (reference: ?MAX_MEMTBL_ENTRIES, src/ra_mt.erl:39)
+MAX_MEMTBL_ENTRIES = 1_000_000
+
+
+class _Table:
+    __slots__ = ("tid", "entries", "seq")
+
+    def __init__(self, tid: int):
+        self.tid = tid
+        self.entries: Dict[int, Entry] = {}
+        # the VISIBLE indexes of this table (truncations shrink it; the
+        # entries dict keeps rows for in-flight flushes)
+        self.seq: Seq = Seq.empty()
+
 
 class MemTable:
-    __slots__ = ("uid", "entries", "_seq")
+    __slots__ = ("uid", "max_entries", "_tables", "_next_tid")
 
-    def __init__(self, uid: str):
+    def __init__(self, uid: str, max_entries: int = MAX_MEMTBL_ENTRIES):
         self.uid = uid
-        self.entries: Dict[int, Entry] = {}
-        self._seq: Seq = Seq.empty()
+        self.max_entries = max_entries
+        self._tables: List[_Table] = [_Table(0)]  # newest first
+        self._next_tid = 1
 
-    def insert(self, entry: Entry) -> None:
-        """Insert; overwriting an existing index truncates everything at
-        and above it first (divergent-suffix rewrite)."""
-        if entry.index in self.entries:
-            self.truncate_from(entry.index)
-        self.entries[entry.index] = entry
-        self._seq = self._seq.add(entry.index)
+    # -- writes ------------------------------------------------------------
 
-    def insert_sparse(self, entry: Entry) -> None:
-        """Out-of-order insert for snapshot live entries."""
-        self.entries[entry.index] = entry
-        self._seq = self._seq.add(entry.index)
+    @property
+    def current_tid(self) -> int:
+        return self._tables[0].tid
+
+    def _successor(self) -> _Table:
+        t = _Table(self._next_tid)
+        self._next_tid += 1
+        self._tables.insert(0, t)
+        return t
+
+    def insert(self, entry: Entry) -> int:
+        """Insert; returns the table id that took the entry. Overwriting
+        an index present in the head table (divergent-suffix rewrite) or
+        exceeding the rotation threshold starts a successor table —
+        never an in-place mutation."""
+        head = self._tables[0]
+        if entry.index in head.entries or len(head.entries) >= self.max_entries:
+            # visibility: everything at/above the overwritten index is
+            # superseded across the whole chain
+            if entry.index in head.entries:
+                self._limit_visible(entry.index - 1)
+            head = self._successor()
+        head.entries[entry.index] = entry
+        head.seq = head.seq.add(entry.index)
+        return head.tid
+
+    def insert_sparse(self, entry: Entry) -> int:
+        """Out-of-order insert for snapshot live entries (no truncation
+        semantics)."""
+        head = self._tables[0]
+        if entry.index in head.entries:
+            head = self._successor()
+        head.entries[entry.index] = entry
+        head.seq = head.seq.add(entry.index)
+        return head.tid
 
     def truncate_from(self, idx: int) -> None:
-        for i in list(self.entries):
-            if i >= idx:
-                del self.entries[i]
-        self._seq = self._seq.limit(idx - 1)
+        self._limit_visible(idx - 1)
+
+    def _limit_visible(self, last: int) -> None:
+        for t in self._tables:
+            t.seq = t.seq.limit(last)
+        self._gc_tables()
+
+    # -- reads -------------------------------------------------------------
 
     def get(self, idx: int) -> Optional[Entry]:
-        return self.entries.get(idx)
+        """Visible read: newest table first, truncations respected."""
+        for t in self._tables:
+            if idx in t.seq:
+                e = t.entries.get(idx)
+                if e is not None:
+                    return e
+        return None
 
-    def record_flushed(self, seq: Seq) -> None:
-        """Delete entries the segment writer has persisted."""
-        for i in seq:
-            self.entries.pop(i, None)
-        self._seq = self._seq.subtract(seq)
+    def get_with_tid(self, idx: int) -> Optional[Tuple[Entry, int]]:
+        """Visible read returning the holding table's id (resends must
+        tag WAL records with the table that actually owns the entry)."""
+        for t in self._tables:
+            if idx in t.seq:
+                e = t.entries.get(idx)
+                if e is not None:
+                    return e, t.tid
+        return None
+
+    def get_from(self, tid: int, idx: int) -> Optional[Entry]:
+        """Exact-table read for flush jobs: returns what that table
+        holds even if a successor has since superseded the index."""
+        for t in self._tables:
+            if t.tid == tid:
+                return t.entries.get(idx)
+        return None
+
+    # -- deletion ----------------------------------------------------------
+
+    def record_flushed(self, seq: Seq, tid: int) -> None:
+        """Delete entries the segment writer persisted from the exact
+        table the WAL handed over (reference: record_flushed on tid)."""
+        for t in self._tables:
+            if t.tid != tid:
+                continue
+            for i in seq:
+                t.entries.pop(i, None)
+            t.seq = t.seq.subtract(seq)
+        self._gc_tables()
 
     def set_first(self, idx: int, live=None) -> None:
         """Drop everything below idx (snapshot truncation), retaining any
         indexes in `live` (a Seq of live indexes below the snapshot)."""
-        for i in list(self.entries):
-            if i < idx and (live is None or i not in live):
-                del self.entries[i]
-        kept = self._seq.floor(idx)
-        if live is not None:
-            kept = kept.union(self._seq.intersect(live))
-        self._seq = kept
+        for t in self._tables:
+            for i in list(t.entries):
+                if i < idx and (live is None or i not in live):
+                    del t.entries[i]
+            kept = t.seq.floor(idx)
+            if live is not None:
+                kept = kept.union(t.seq.intersect(live))
+            t.seq = kept
+        self._gc_tables()
+
+    def _gc_tables(self) -> None:
+        # Drop non-head tables whose VISIBLE seq is empty: every row
+        # still in them is superseded (truncation/overwrite made it
+        # invisible; the replacement entries live in a successor with
+        # their own WAL records), so pending flushes that wanted them
+        # may safely skip. This bounds chain growth under leadership
+        # churn — without it, superseded rows whose file seqs the WAL
+        # rewound are never referenced by any flush and leak forever.
+        self._tables = [self._tables[0]] + [
+            t for t in self._tables[1:] if t.entries and not t.seq.is_empty()
+        ]
+
+    # -- introspection -----------------------------------------------------
 
     def seq(self) -> Seq:
-        return self._seq
+        out = Seq.empty()
+        for t in self._tables:
+            out = out.union(t.seq)
+        return out
 
     def range(self) -> Optional[Tuple[int, int]]:
-        return self._seq.range()
+        return self.seq().range()
+
+    def num_tables(self) -> int:
+        return len(self._tables)
 
     def __len__(self) -> int:
-        return len(self.entries)
+        return sum(len(t.entries) for t in self._tables)

@@ -67,11 +67,18 @@ class SegmentWriter:
 
     # ------------------------------------------------------------------
 
-    def flush_mem_tables(self, seqs: Dict[str, Seq], wal_file: Optional[str] = None) -> None:
+    def flush_mem_tables(
+        self, seqs: Dict[str, List[Tuple[int, Seq]]],
+        wal_file: Optional[str] = None,
+    ) -> None:
+        """``seqs``: {uid: [(tid, Seq), ...]} — the successor-chain
+        handoff from WAL rollover (tid names the memtable table that
+        holds each file's entries)."""
+        norm = {uid: list(ts) for uid, ts in seqs.items()}
         with self._cv:
             if self._closed:
                 return
-            self._queue.append((dict(seqs), wal_file, 0))
+            self._queue.append((norm, wal_file, 0))
             self._idle.clear()
             self._cv.notify()
         if self._thread is None:
@@ -142,7 +149,7 @@ class SegmentWriter:
             if wal_file and os.path.exists(wal_file):
                 os.unlink(wal_file)
 
-    def _flush_job(self, seqs: Dict[str, Seq]) -> None:
+    def _flush_job(self, seqs) -> None:
         # uids are removed from ``seqs`` as they complete so a retried
         # job (requeued by _drain on failure) never replays finished
         # uids' appends/notifications
@@ -150,36 +157,42 @@ class SegmentWriter:
             self._flush_uid(uid, seqs[uid])
             del seqs[uid]
 
-    def _flush_uid(self, uid: str, seq: Seq) -> None:
+    def _flush_uid(self, uid: str, tid_seqs) -> None:
         # flush floor: skip dead indexes below the snapshot, keep live
         # ones (reference: start_index/smallest_live_idx truncation,
-        # src/ra_log_segment_writer.erl:268-390)
+        # src/ra_log_segment_writer.erl:268-390). Entries are read from
+        # the EXACT memtable table the WAL file referenced (successor
+        # chains): a concurrent divergent overwrite must not change what
+        # this flush persists.
         snap_idx = self.tables.snapshot_index(uid)
         live = self.tables.live_indexes(uid)
-        keep = seq.floor(snap_idx + 1).union(seq.intersect(live))
         mt = self.tables.mem_table(uid)
         new_refs: List[Tuple[str, Tuple[int, int]]] = []
         handle = self._open_segment(uid)
         wrote = 0
-        for idx in keep:
-            entry = mt.get(idx)
-            if entry is None:
-                continue  # already truncated/compacted away
-            if handle.is_full():
-                handle.sync()
-                handle.close()
-                if handle.range:
-                    new_refs.append((os.path.basename(handle.path), handle.range))
-                handle = self._roll_segment(uid)
-            handle.append(entry.index, entry.term, encode_cmd(entry.cmd))
-            wrote += 1
+        flushed: List[Tuple[int, Seq]] = []
+        for tid, seq in tid_seqs:
+            keep = seq.floor(snap_idx + 1).union(seq.intersect(live))
+            for idx in keep:
+                entry = mt.get_from(tid, idx)
+                if entry is None:
+                    continue  # already truncated/compacted away
+                if handle.is_full():
+                    handle.sync()
+                    handle.close()
+                    if handle.range:
+                        new_refs.append((os.path.basename(handle.path), handle.range))
+                    handle = self._roll_segment(uid)
+                handle.append(entry.index, entry.term, encode_cmd(entry.cmd))
+                wrote += 1
+            flushed.append((tid, seq))
         if wrote:
             handle.sync()
             self.counter.incr("entries_flushed", wrote)
         self.counter.incr("mem_tables_flushed")
         if handle.range:
             new_refs.append((os.path.basename(handle.path), handle.range))
-        self.notify(uid, ("segments", seq, new_refs))
+        self.notify(uid, ("segments", flushed, new_refs))
 
     def _server_dir(self, uid: str) -> str:
         return os.path.join(self.data_dir, uid, "segments")

@@ -106,7 +106,8 @@ class Wal:
         self._file_path: Optional[str] = None
         self._bytes = 0
         self._uid_refs: Dict[str, int] = {}
-        self._file_seqs: Dict[str, Seq] = {}  # what this file holds, per uid
+        # what this file holds: per uid, per memtable table id
+        self._file_seqs: Dict[str, Dict[int, Seq]] = {}
         # per-writer last contiguous idx (gap detection)
         self._last_idx: Dict[str, int] = {}
 
@@ -122,15 +123,18 @@ class Wal:
     # public API
 
     def write(
-        self, uid: str, idx: int, term: int, payload: bytes, sparse: bool = False
+        self, uid: str, idx: int, term: int, payload: bytes,
+        sparse: bool = False, tid: int = 0,
     ) -> bool:
         """Queue an append. ``sparse`` marks out-of-order live-entry
-        writes (snapshot install pre-phase) that bypass gap detection.
+        writes (snapshot install pre-phase) that bypass gap detection;
+        ``tid`` names the memtable table holding the entry (successor
+        chains — the segment writer flushes from exactly that table).
         Returns False when the WAL is closed."""
         with self._cv:
             if self._closed or self._failed:
                 return False
-            self._queue.append(("s" if sparse else "w", uid, idx, term, payload))
+            self._queue.append(("s" if sparse else "w", uid, idx, term, payload, tid))
             self._cv.notify()
         return True
 
@@ -140,7 +144,7 @@ class Wal:
         with self._cv:
             if self._closed or self._failed:
                 return False
-            self._queue.append(("t", uid, idx, 0, b""))
+            self._queue.append(("t", uid, idx, 0, b"", 0))
             self._cv.notify()
         return True
 
@@ -197,12 +201,13 @@ class Wal:
         # (uid, term) -> indexes written in this batch
         written: Dict[Tuple[str, int], List[int]] = {}
         resends: List[Tuple[str, int]] = []
-        for kind, uid, idx, term, payload in batch:
+        for kind, uid, idx, term, payload, tid in batch:
             if kind == "t":
                 ref = self._uid_ref(uid, records)
                 records.append((K_TRUNC, ref, idx, 0, b""))
                 self._last_idx[uid] = idx - 1
-                self._file_seqs[uid] = self._file_seqs.get(uid, Seq.empty()).limit(idx - 1)
+                for t, sq in self._file_seqs.get(uid, {}).items():
+                    self._file_seqs[uid][t] = sq.limit(idx - 1)
                 continue
             snap_idx = self.tables.snapshot_index(uid)
             # drop writes below the snapshot floor (dead indexes); they
@@ -224,16 +229,20 @@ class Wal:
                     continue
             ref = self._uid_ref(uid, records)
             records.append((K_SPARSE if kind == "s" else K_ENTRY, ref, idx, term, payload))
-            seq = self._file_seqs.get(uid, Seq.empty())
+            per_uid = self._file_seqs.setdefault(uid, {})
             if kind == "s":
                 # sparse writes never imply truncation of higher indexes
                 self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
-                self._file_seqs[uid] = seq.add(idx)
+                per_uid[tid] = per_uid.get(tid, Seq.empty()).add(idx)
             else:
                 self._last_idx[uid] = idx
-                if idx <= (seq.last() or 0):
-                    seq = seq.limit(idx - 1)  # overwrite rewinds
-                self._file_seqs[uid] = seq.add(idx)
+                last_any = max((sq.last() or 0 for sq in per_uid.values()), default=0)
+                if idx <= last_any:
+                    # overwrite rewinds this file's view across ALL
+                    # tables of the uid (superseded entries)
+                    for t in list(per_uid):
+                        per_uid[t] = per_uid[t].limit(idx - 1)
+                per_uid[tid] = per_uid.get(tid, Seq.empty()).add(idx)
             written.setdefault((uid, term), []).append(idx)
 
         if records:
@@ -333,8 +342,12 @@ class Wal:
         full_path, seqs = self._file_path, self._file_seqs
         self._open_next()
         if self.segment_writer is not None:
+            jobs = {
+                uid: [(t, sq) for t, sq in sorted(per.items()) if not sq.is_empty()]
+                for uid, per in seqs.items()
+            }
             self.segment_writer.flush_mem_tables(
-                {uid: seq for uid, seq in seqs.items() if not seq.is_empty()},
+                {uid: ts for uid, ts in jobs.items() if ts},
                 wal_file=full_path,
             )
         # no segment writer: the rolled file is the only durable copy of
@@ -400,7 +413,12 @@ class Wal:
             if live_seqs is None:
                 continue
             if self.segment_writer is not None and live_seqs:
-                self.segment_writer.flush_mem_tables(live_seqs, wal_file=path)
+                self.segment_writer.flush_mem_tables(
+                    {u: [(t, sq) for t, sq in sorted(per.items())
+                         if not sq.is_empty()]
+                     for u, per in live_seqs.items()},
+                    wal_file=path,
+                )
             elif not live_seqs:
                 os.unlink(path)
             # else: no segment writer configured — the file is the only
@@ -415,10 +433,10 @@ class Wal:
     # boot; reference reads 32 MB chunks, src/ra_log_wal.erl:393-470)
     RECOVER_CHUNK = 8 * 1024 * 1024
 
-    def _recover_file(self, path: str, Entry, pickle) -> Optional[Dict[str, Seq]]:
-        """Parse one WAL file streaming; returns {uid: live seq} or None
-        when the file was unreadable/invalid (and removed)."""
-        seqs: Dict[str, Seq] = {}
+    def _recover_file(self, path: str, Entry, pickle):
+        """Parse one WAL file streaming; returns {uid: {tid: seq}} or
+        None when the file was unreadable/invalid (and removed)."""
+        seqs: Dict[str, Dict[int, Seq]] = {}
         uids: Dict[int, str] = {}
         try:
             f = open(path, "rb")
@@ -465,7 +483,8 @@ class Wal:
                         pos += _TRUNC_HDR.size
                         uid = uids[ref]
                         self.tables.mem_table(uid).truncate_from(idx)
-                        seqs[uid] = seqs.get(uid, Seq.empty()).limit(idx - 1)
+                        for t in list(seqs.get(uid, {})):
+                            seqs[uid][t] = seqs[uid][t].limit(idx - 1)
                         self._last_idx[uid] = idx - 1
                     elif kind in (K_ENTRY, K_SPARSE):
                         if not ensure(_ENTRY_HDR.size):
@@ -489,25 +508,31 @@ class Wal:
                             self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
                             continue
                         mt = self.tables.mem_table(uid)
+                        per = seqs.setdefault(uid, {})
                         if kind == K_SPARSE:
                             # sparse records carry no contiguity or
                             # truncation semantics: never rewind the
                             # writer watermark or clip higher entries
-                            mt.insert_sparse(Entry(idx, term, pickle.loads(payload)))
-                            seqs[uid] = seqs.get(uid, Seq.empty()).add(idx)
+                            t = mt.insert_sparse(Entry(idx, term, pickle.loads(payload)))
+                            per[t] = per.get(t, Seq.empty()).add(idx)
                             self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
                             continue
-                        mt.insert(Entry(idx, term, pickle.loads(payload)))
-                        seq = seqs.get(uid, Seq.empty())
-                        if idx <= (seq.last() or 0):
-                            seq = seq.limit(idx - 1)
-                        seqs[uid] = seq.add(idx)
+                        t = mt.insert(Entry(idx, term, pickle.loads(payload)))
+                        last_any = max((sq.last() or 0 for sq in per.values()), default=0)
+                        if idx <= last_any:
+                            for tt in list(per):
+                                per[tt] = per[tt].limit(idx - 1)
+                        per[t] = per.get(t, Seq.empty()).add(idx)
                         self._last_idx[uid] = idx
                     else:
                         break  # unknown/corrupt: stop at tail
                 except (struct.error, KeyError, IndexError, EOFError):
                     break
-        return {u: s for u, s in seqs.items() if not s.is_empty()}
+        return {
+            u: {t: sq for t, sq in per.items() if not sq.is_empty()}
+            for u, per in seqs.items()
+            if any(not sq.is_empty() for sq in per.values())
+        }
 
     def overview(self) -> Dict[str, Any]:
         return {

@@ -535,14 +535,14 @@ def test_segment_writer_retains_wal_file_on_flush_failure(tmp_path, monkeypatch)
         raise OSError("disk on fire")
 
     monkeypatch.setattr(sw, "_flush_job", boom)
-    sw.flush_mem_tables({"u1": Seq.from_list([1, 2, 3])}, wal_file=wal_file)
+    sw.flush_mem_tables({"u1": [(0, Seq.from_list([1, 2, 3]))]}, wal_file=wal_file)
     assert calls["n"] == 2  # retried, then gave up
     assert os.path.exists(wal_file)  # durable copy retained
     assert sw.counter.to_dict()["flush_errors"] == 2
 
     # the writer still works after the failure
     monkeypatch.setattr(sw, "_flush_job", real)
-    sw.flush_mem_tables({"u1": Seq.from_list([1, 2, 3])}, wal_file=wal_file)
+    sw.flush_mem_tables({"u1": [(0, Seq.from_list([1, 2, 3]))]}, wal_file=wal_file)
     assert sink.of("u1", "segments")
     assert not os.path.exists(wal_file)
     sw.close()
@@ -767,3 +767,64 @@ def test_files_for_interval_index_probe_count(tmp_path):
     # the 1000 refs (a linear scan would touch all of them)
     assert hit_probes <= 4, hit_probes
     assert miss_probes <= 2, miss_probes
+
+
+# ---------------------------------------------------------------------------
+# memtable successor chains (reference: ra_mt successor chaining on
+# overwrite / size rotation, src/ra_mt.erl:86-225; entries are never
+# overwritten in place, docs/internals/LOG.md:82-96)
+
+
+def test_memtable_successor_chain_on_overwrite():
+    mt = MemTable("u1")
+    t0 = mt.insert(Entry(1, 1, "a"))
+    assert mt.insert(Entry(2, 1, "b")) == t0
+    # divergent rewrite at 2 starts a successor; the old table keeps its row
+    t1 = mt.insert(Entry(2, 2, "b2"))
+    assert t1 != t0 and mt.num_tables() == 2
+    assert mt.get(2).term == 2  # visible read: newest wins
+    assert mt.get_from(t0, 2).term == 1  # exact-table read: old preserved
+    # flush of the old table completes -> old table garbage collected
+    mt.record_flushed(Seq.from_list([1, 2]), tid=t0)
+    assert mt.num_tables() == 1
+    assert mt.get(2).term == 2  # successor untouched
+
+
+def test_memtable_rotation_at_max_entries():
+    mt = MemTable("u1", max_entries=4)
+    tids = {mt.insert(Entry(i, 1, i)) for i in range(1, 10)}
+    assert len(tids) >= 2 and mt.num_tables() >= 2
+    for i in range(1, 10):
+        assert mt.get(i) is not None
+
+
+def test_flush_reads_exact_table_despite_concurrent_overwrite(tmp_path):
+    """The race successor chains exist for: a rolled WAL file's flush
+    must persist the entries that file contained, even when the server
+    overwrites a divergent suffix before the flush runs."""
+    sink = Sink()
+    tables = TableRegistry()
+    sw = SegmentWriter(str(tmp_path / "data"), tables, sink, threaded=False)
+    mt = tables.mem_table("u1")
+    t0 = None
+    for i in range(1, 6):
+        t0 = mt.insert(Entry(i, 1, f"old{i}"))
+    # WAL rolled: flush job for table t0 is pending. Before it runs, a
+    # new leader overwrites 3..5 (lands in a successor table).
+    for i in range(3, 6):
+        mt.insert(Entry(i, 2, f"new{i}"))
+    sw.flush_mem_tables({"u1": [(t0, Seq.from_list([1, 2, 3, 4, 5]))]})
+    # the flush persisted the OLD entries (what the old WAL file held)
+    from ra_tpu.log.segments import SegmentSet
+
+    segs = SegmentSet(str(tmp_path / "data" / "u1" / "segments"))
+    assert segs.fetch(4).term == 1
+    # the memtable still serves the NEW entries (visible view), and the
+    # old table was cleaned up by the flush notification
+    evt = sink.of("u1", "segments")[-1]
+    for tid, seq in evt[1]:
+        mt.record_flushed(seq, tid=tid)
+    assert mt.get(4).term == 2
+    assert mt.num_tables() == 1
+    segs.close()
+    sw.close()
