@@ -1,0 +1,81 @@
+"""Adaptive (phi-accrual) node failure detector.
+
+The role of the reference's ``aten`` dependency (reference:
+``src/ra_server_proc.erl:384`` registers with aten; aten 0.6.0 is a
+poll-based adaptive detector): instead of a fixed liveness deadline,
+track the inter-arrival times of liveness evidence per node and compute
+
+    phi(t) = -log10( P(no evidence for t, given the observed history) )
+
+under a normal model of the sampled intervals. ``phi`` grows smoothly
+as evidence stops arriving; a node is *suspect* above a threshold
+(default 8 — roughly "this silence had probability 1e-8"). Adaptive:
+on a jittery link the learned variance widens and suspicion slows
+down; on a steady link it tightens.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+
+class PhiAccrualDetector:
+    def __init__(
+        self,
+        threshold: float = 8.0,
+        window: int = 64,
+        min_std: float = 0.01,
+        bootstrap_interval: float = 0.5,
+    ):
+        self.threshold = threshold
+        self.window = window
+        self.min_std = min_std
+        self.bootstrap_interval = bootstrap_interval
+        self._lock = threading.Lock()
+        self._last: Dict[str, float] = {}
+        self._intervals: Dict[str, Deque[float]] = {}
+
+    def heartbeat(self, node: str, now: Optional[float] = None) -> None:
+        """Record liveness evidence for ``node`` (a fresh pong, an
+        inbound message, a successful poll)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            prev = self._last.get(node)
+            self._last[node] = now
+            if prev is not None:
+                iv = self._intervals.setdefault(node, deque(maxlen=self.window))
+                iv.append(max(now - prev, 1e-6))
+
+    def phi(self, node: str, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            last = self._last.get(node)
+            if last is None:
+                return 0.0  # never seen: no evidence either way
+            iv = self._intervals.get(node)
+            if not iv:
+                mean, std = self.bootstrap_interval, self.bootstrap_interval / 2
+            else:
+                mean = sum(iv) / len(iv)
+                var = sum((x - mean) ** 2 for x in iv) / len(iv)
+                std = max(math.sqrt(var), self.min_std, mean / 10)
+        elapsed = now - last
+        # P(interval > elapsed) under N(mean, std), via the logistic
+        # approximation of the normal CDF (cheap, monotone, and the
+        # standard trick in phi-accrual implementations)
+        y = (elapsed - mean) / std
+        p = 1.0 / (1.0 + math.exp(-y * 1.702))
+        p_longer = max(1.0 - p, 1e-12)
+        return -math.log10(p_longer)
+
+    def suspect(self, node: str, now: Optional[float] = None) -> bool:
+        return self.phi(node, now) > self.threshold
+
+    def forget(self, node: str) -> None:
+        with self._lock:
+            self._last.pop(node, None)
+            self._intervals.pop(node, None)

@@ -342,16 +342,22 @@ class Wal:
         full_path, seqs = self._file_path, self._file_seqs
         self._open_next()
         if self.segment_writer is not None:
-            jobs = {
-                uid: [(t, sq) for t, sq in sorted(per.items()) if not sq.is_empty()]
-                for uid, per in seqs.items()
-            }
             self.segment_writer.flush_mem_tables(
-                {uid: ts for uid, ts in jobs.items() if ts},
-                wal_file=full_path,
+                self._flush_jobs(seqs), wal_file=full_path
             )
         # no segment writer: the rolled file is the only durable copy of
         # its entries — keep it for boot-time recovery
+
+    @staticmethod
+    def _flush_jobs(seqs):
+        """{uid: {tid: Seq}} -> {uid: [(tid, Seq), ...]} handoff shape
+        (tid-ordered, empties dropped) — one definition for the roll and
+        recovery paths."""
+        jobs = {
+            uid: [(t, sq) for t, sq in sorted(per.items()) if not sq.is_empty()]
+            for uid, per in seqs.items()
+        }
+        return {uid: ts for uid, ts in jobs.items() if ts}
 
     def force_rollover(self) -> None:
         """Test/ops hook: roll the current file regardless of size."""
@@ -414,10 +420,7 @@ class Wal:
                 continue
             if self.segment_writer is not None and live_seqs:
                 self.segment_writer.flush_mem_tables(
-                    {u: [(t, sq) for t, sq in sorted(per.items())
-                         if not sq.is_empty()]
-                     for u, per in live_seqs.items()},
-                    wal_file=path,
+                    self._flush_jobs(live_seqs), wal_file=path
                 )
             elif not live_seqs:
                 os.unlink(path)

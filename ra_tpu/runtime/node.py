@@ -117,6 +117,9 @@ class RaNode:
             threaded=True,
         )
         self.wal.on_failure = self._on_wal_failure
+        from ra_tpu.detector import PhiAccrualDetector
+
+        self.detector = PhiAccrualDetector()
         self._registry = nodes or node_registry()
         if tcp:
             # real sockets: name must be "host:port"; peers are remote
@@ -124,6 +127,7 @@ class RaNode:
             from ra_tpu.runtime.tcp import TcpTransport
 
             self.transport = TcpTransport(name, self.deliver)
+            self.transport.detector = self.detector  # adaptive liveness
             self.transport.on_proc_down_cb = self.on_proc_down
             self.transport.on_mgmt_cb = self._handle_mgmt
         else:
@@ -506,6 +510,9 @@ class RaNode:
                 for other in self.transport.known_nodes():
                     if other == self.name:
                         continue
+                    # over TCP, node_alive consults the phi-accrual
+                    # detector fed by pong arrivals (adaptive window);
+                    # in-proc, registry membership is ground truth
                     alive = self.transport.node_alive(other)
                     prev = self._node_status.get(other)
                     if prev is None:
@@ -556,7 +563,7 @@ class RaNode:
         for watcher, component in self.monitors.watchers("process", sid):
             proc = self.procs.get(watcher[0])
             if proc is not None:
-                proc.enqueue(DownEvent(sid, "noproc"))
+                proc.on_monitor_down(sid, "noproc", component)
 
     # ------------------------------------------------------------------
 

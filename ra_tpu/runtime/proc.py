@@ -546,6 +546,19 @@ class ServerProc:
     # ------------------------------------------------------------------
     # failure-detector input
 
+    def on_monitor_down(self, target, info, component: str) -> None:
+        """Dispatch a monitor DOWN to the registered component
+        (reference: ra_monitors routes DOWNs to machine / aux /
+        snapshot_sender, src/ra_monitors.erl:10-22)."""
+        if component == "aux":
+            self.enqueue(("aux", "cast", ("down", target, info), None))
+        elif component == "snapshot_sender":
+            # treat like a failed transfer to that peer: backoff/retry
+            if target in self._senders:
+                self.enqueue(("snapshot_send_failed", target))
+        else:  # "machine" (default): the down builtin via consensus
+            self.enqueue(DownEvent(target, info))
+
     def on_node_event(self, node_name: str, status: str) -> None:
         """Called (via mailbox) when the failure detector flips a node."""
         srv = self.server

@@ -92,10 +92,16 @@ class TcpTransport:
             target=self._accept_loop, name=f"ra-tcp-accept-{node_name}", daemon=True
         )
         self._accept_thread.start()
-        # liveness: ping every known peer; a peer is alive while pongs are
-        # fresh (the aten-style poll the reference's detector relies on)
+        # liveness: ping every known peer; a peer is alive while pongs
+        # are fresh. With a ``detector`` (ra_tpu.detector.
+        # PhiAccrualDetector) attached, pong ARRIVALS feed it and
+        # node_alive uses the adaptive phi window instead of the fixed
+        # timeout — jittery links widen their window, steady links
+        # tighten (the aten role; both backends share this transport,
+        # so liveness semantics stay uniform)
         self.ping_interval_s = 0.2
         self.pong_timeout_s = 1.0
+        self.detector = None
         self._last_pong: Dict[str, float] = {}
         # set by the owning node: called with a ServerId when a remote
         # peer announces one of its procs died
@@ -163,7 +169,12 @@ class TcpTransport:
         import time as _t
 
         last = self._last_pong.get(node_name)
-        return last is not None and (_t.monotonic() - last) < self.pong_timeout_s
+        if last is None:
+            return False
+        d = self.detector
+        if d is not None:
+            return not d.suspect(node_name)
+        return (_t.monotonic() - last) < self.pong_timeout_s
 
     def proc_alive(self, sid: ServerId) -> bool:
         # remote proc liveness is not observable over TCP; approximate
@@ -350,6 +361,9 @@ class TcpTransport:
                         import time as _t
 
                         self._last_pong[from_sid] = _t.monotonic()
+                        d = self.detector
+                        if d is not None:
+                            d.heartbeat(from_sid)
                         continue
                     if to_name == "__mgmt__":
                         corr, op, kwargs = msg

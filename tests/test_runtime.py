@@ -248,3 +248,82 @@ def test_many_groups_share_node_infra(tmp_path):
     finally:
         api.stop_node("nM")
         leaderboard.clear()
+
+
+# ---------------------------------------------------------------------------
+# adaptive failure detection (reference: aten) + monitor component routing
+# (reference: ra_monitors)
+
+
+def test_phi_accrual_detector_adapts():
+    from ra_tpu.detector import PhiAccrualDetector
+
+    d = PhiAccrualDetector(threshold=8.0)
+    t = 100.0
+    # steady 0.1s heartbeats
+    for i in range(30):
+        d.heartbeat("n1", now=t + i * 0.1)
+    t2 = t + 30 * 0.1
+    assert not d.suspect("n1", now=t2 + 0.1)  # one missed beat: fine
+    assert d.suspect("n1", now=t2 + 5.0)  # long silence: suspect
+    # a jittery node with 1s +/- heartbeats is NOT suspected at 2s
+    tj = 200.0
+    import random
+
+    rng = random.Random(1)
+    for i in range(30):
+        tj += 0.5 + rng.random()
+        d.heartbeat("n2", now=tj)
+    assert not d.suspect("n2", now=tj + 2.0)
+    assert d.suspect("n2", now=tj + 30.0)
+    # unseen node: no evidence, no suspicion
+    assert not d.suspect("ghost")
+    d.forget("n1")
+    assert not d.suspect("n1", now=t2 + 99)
+
+
+def test_monitor_down_routed_by_component(tmp_path):
+    """DOWNs dispatch to the registered component: machine gets the
+    builtin command, aux gets a cast, snapshot senders a failure."""
+    import time as _time
+
+    from ra_tpu import api, leaderboard
+    from ra_tpu.machine import Machine
+    from ra_tpu.runtime.transport import registry
+    from ra_tpu.system import SystemConfig
+
+    seen = {"machine": [], "aux": []}
+
+    class MonMachine(Machine):
+        def init(self, config):
+            return 0
+
+        def apply(self, meta, cmd, state):
+            if isinstance(cmd, tuple) and cmd and cmd[0] == "down":
+                seen["machine"].append(cmd[1])
+            return state, None, []
+
+        def handle_aux(self, role, kind, cmd, aux_state, intern):
+            if isinstance(cmd, tuple) and cmd and cmd[0] == "down":
+                seen["aux"].append(cmd[1])
+            return None, aux_state
+
+    leaderboard.clear()
+    api.start_node("mdA", SystemConfig(name="md", data_dir=str(tmp_path)),
+                   election_timeout_s=0.1, tick_interval_s=0.05)
+    sid = ("md1", "mdA")
+    api.start_server(sid, "mdc", MonMachine(), (sid,))
+    api.trigger_election(sid)
+    api.process_command(sid, 1, timeout=10)
+    node = registry().get("mdA")
+    node.monitors.add(sid, "process", ("tgt1", "mdA"), "machine")
+    node.monitors.add(sid, "process", ("tgt2", "mdA"), "aux")
+    node.on_proc_down(("tgt1", "mdA"))
+    node.on_proc_down(("tgt2", "mdA"))
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and not (seen["machine"] and seen["aux"]):
+        _time.sleep(0.05)
+    assert seen["machine"] == [("tgt1", "mdA")]
+    assert seen["aux"] == [("tgt2", "mdA")]
+    api.stop_node("mdA")
+    leaderboard.clear()

@@ -312,3 +312,37 @@ def test_remote_management_over_tcp(tmp_path):
             api.stop_node(local_name)
         except Exception:
             pass
+
+
+def test_tcp_node_alive_uses_phi_detector():
+    """With a detector attached, pong arrivals drive an adaptive
+    liveness window instead of the fixed pong timeout."""
+    from ra_tpu.detector import PhiAccrualDetector
+    from ra_tpu.runtime.tcp import TcpTransport
+
+    a_port, b_port = free_port(), free_port()
+    a = TcpTransport(f"127.0.0.1:{a_port}", lambda t, m, f: True)
+    b = TcpTransport(f"127.0.0.1:{b_port}", lambda t, m, f: True)
+    a.detector = PhiAccrualDetector(threshold=8.0)
+    try:
+        b_name = f"127.0.0.1:{b_port}"
+        a.send(("x", b_name), ("hi",), None)  # dial: starts ping/pong
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not a.node_alive(b_name):
+            time.sleep(0.05)
+        assert a.node_alive(b_name)
+        # detector has been fed by pong arrivals
+        assert a.detector.phi(b_name) >= 0.0
+        time.sleep(1.0)  # steady pongs keep phi low
+        assert a.node_alive(b_name)
+        b.close()  # pongs stop: adaptive suspicion flips liveness
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and a.node_alive(b_name):
+            time.sleep(0.1)
+        assert not a.node_alive(b_name)
+    finally:
+        a.close()
+        try:
+            b.close()
+        except Exception:
+            pass
