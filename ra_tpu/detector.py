@@ -24,6 +24,10 @@ from typing import Deque, Dict, Optional
 
 
 class PhiAccrualDetector:
+    # -log10(1e-12) bounds phi at 12: thresholds at/above it would make
+    # suspect() permanently false, so they are clamped
+    MAX_THRESHOLD = 11.0
+
     def __init__(
         self,
         threshold: float = 8.0,
@@ -31,7 +35,7 @@ class PhiAccrualDetector:
         min_std: float = 0.01,
         bootstrap_interval: float = 0.5,
     ):
-        self.threshold = threshold
+        self.threshold = min(threshold, self.MAX_THRESHOLD)
         self.window = window
         self.min_std = min_std
         self.bootstrap_interval = bootstrap_interval
@@ -47,8 +51,18 @@ class PhiAccrualDetector:
             prev = self._last.get(node)
             self._last[node] = now
             if prev is not None:
+                interval = max(now - prev, 1e-6)
                 iv = self._intervals.setdefault(node, deque(maxlen=self.window))
-                iv.append(max(now - prev, 1e-6))
+                if iv:
+                    mean = sum(iv) / len(iv)
+                    if interval > 4 * mean + 1.0:
+                        # an outage gap, not a cadence sample: recording
+                        # it would inflate mean/std and blind the
+                        # detector to the NEXT failure for minutes —
+                        # treat as a restart and relearn the cadence
+                        iv.clear()
+                        return
+                iv.append(interval)
 
     def phi(self, node: str, now: Optional[float] = None) -> float:
         now = time.monotonic() if now is None else now

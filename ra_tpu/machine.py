@@ -115,6 +115,17 @@ def resolve_machine_factory(spec: str, machine_config: Optional[Dict[str, Any]] 
     return fn(cfg)
 
 
+def normalize_aux_result(res, aux_state) -> Tuple[Any, Any, List[Effect]]:
+    """handle_aux contract: None | (reply, aux_state) |
+    (reply, aux_state, effects) -> (reply, aux_state, effects). One
+    definition shared by both execution backends."""
+    if res is None:
+        return None, aux_state, []
+    if len(res) == 2:
+        return res[0], res[1], []
+    return res[0], res[1], list(res[2])
+
+
 def normalize_apply_result(res) -> Tuple[Any, Any, List[Effect]]:
     if isinstance(res, tuple):
         if len(res) == 2:

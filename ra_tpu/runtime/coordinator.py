@@ -104,7 +104,7 @@ class GroupHost:
         "voter_status", "cluster_change_permitted", "cluster_index",
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
-        "last_ack",
+        "last_ack", "aux_state", "aux_inited",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -170,6 +170,9 @@ class GroupHost:
         # per-slot monotonic time of the last AER ack (leader-side);
         # drives the periodic resync of silent peers
         self.last_ack: Dict[int, float] = {}
+        # aux machine state (initialized lazily on first aux message)
+        self.aux_state: Any = None
+        self.aux_inited = False
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -1104,9 +1107,10 @@ class BatchCoordinator:
     def _realise_effects(self, g: GroupHost, effs, is_leader: bool = True) -> None:
         """Machine effects. Log effects (release_cursor / checkpoint)
         are realised on EVERY replica — followers must truncate too;
-        the rest (send_msg, mod_call, timer, log read, reply) are
-        leader-only. Monitor/demonitor and aux need the actor runtime —
-        groups using them should run on the per_group_actor backend."""
+        the rest (send_msg, mod_call, timer, log read, reply, aux) are
+        leader-only on the apply path. Monitor/demonitor effects need
+        the actor runtime's monitor registry — groups using them should
+        run on the per_group_actor backend."""
         for eff in effs:
             if not is_leader and not isinstance(
                 eff, (fx.ReleaseCursor, fx.Checkpoint)
@@ -1156,6 +1160,10 @@ class BatchCoordinator:
                     self.deliver((g.name, self.name), out, None)
             elif isinstance(eff, fx.Reply):
                 self._reply(eff.from_ref, eff.reply)
+            elif isinstance(eff, fx.Aux):
+                self.deliver(
+                    (g.name, self.name), ("aux", "cast", eff.cmd, None), None
+                )
 
     def _sync_snapshot_floor(self, g: GroupHost) -> None:
         snap = g.log.snapshot_index_term()
@@ -1339,6 +1347,9 @@ class BatchCoordinator:
         if isinstance(msg, HeartbeatReply):
             self._handle_heartbeat_reply(g, msg, from_sid)
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "aux":
+            self._handle_aux(g, msg[1], msg[2], msg[3])
+            return
         if isinstance(msg, tuple) and msg and msg[0] == "state_query":
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g), g.sid_of(g.leader_slot)))
@@ -1404,6 +1415,58 @@ class BatchCoordinator:
                     # resume pipelining the post-snapshot tail right away
                     self._send_aers({g.gid})
             return
+
+    _ROLE_NAMES = {0: "follower", 1: "pre_vote", 2: "candidate", 3: "leader"}
+
+    class _AuxServerShim:
+        """Duck-types the Server surface AuxContext reads, over a
+        GroupHost (machine state, membership, indexes, log)."""
+
+        def __init__(self, coord: "BatchCoordinator", g: GroupHost):
+            self.machine_state = g.machine_state
+            self.leader_id = g.sid_of(g.leader_slot)
+            self.current_term = g.term
+            self.commit_index = g.last_applied
+            self.last_applied = g.last_applied
+            self.log = g.log
+            self._g = g
+            self._coord = coord
+
+        def members(self):
+            return [m for m in self._g.members if m is not None]
+
+        def overview(self):
+            g = self._g
+            return {
+                "id": (g.name, self._coord.name),
+                "backend": "tpu_batch",
+                "role": BatchCoordinator._ROLE_NAMES.get(g.role, g.role),
+                "term": g.term,
+                "last_applied": g.last_applied,
+                "machine": g.machine.overview(g.machine_state),
+            }
+
+    def _handle_aux(self, g: GroupHost, kind: str, cmd, from_ref) -> None:
+        """Aux machine plumbing for batch-backed groups (reference:
+        ra_aux surface, src/ra_aux.erl:8-23)."""
+        from ra_tpu.aux import AuxContext
+
+        if not g.aux_inited:
+            g.aux_state = g.machine.init_aux(g.cluster_name)
+            g.aux_inited = True
+        from ra_tpu.machine import normalize_aux_result
+
+        res = g.machine.handle_aux(
+            self._ROLE_NAMES.get(g.role, "follower"), kind, cmd, g.aux_state,
+            AuxContext(self._AuxServerShim(self, g)),
+        )
+        reply, g.aux_state, effs = normalize_aux_result(res, g.aux_state)
+        if effs:
+            # aux effects are realized regardless of role (matching the
+            # proc backend, which executes them ungated)
+            self._realise_effects(g, effs, True)
+        if kind == "call" and from_ref is not None:
+            self._reply(from_ref, ("ok", reply, (g.name, self.name)))
 
     def _voter_count(self, g: GroupHost) -> int:
         return sum(

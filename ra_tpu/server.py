@@ -1627,16 +1627,14 @@ class Server:
 
         if not hasattr(self, "aux_state"):
             self.aux_state = self.machine.init_aux(self.cfg.cluster_name)
+        from ra_tpu.machine import normalize_aux_result
+
         res = self.machine.handle_aux(
             self.role, kind, cmd, self.aux_state, AuxContext(self)
         )
+        reply, self.aux_state, aux_effects = normalize_aux_result(res, self.aux_state)
         if res is None:
             return effects
-        if len(res) == 2:
-            reply, self.aux_state = res
-            aux_effects: List[Effect] = []
-        else:
-            reply, self.aux_state, aux_effects = res
         effects.extend(aux_effects)
         if kind == "call" and from_ref is not None:
             effects.append(Reply(from_ref, ("ok", reply, self.id)))
