@@ -7,10 +7,12 @@ release-cursor emission once everything settled.
 
 Commands:
   ("enqueue", msg)
-  ("checkout", consumer_id)          -- register a consumer (prefetch 1)
+  ("checkout", consumer_id[, prefetch])  -- register a consumer
+  ("dequeue", consumer_id)           -- one-shot take (auto-settled)
   ("settle", consumer_id, msg_id)
   ("return", consumer_id, msg_id)    -- redeliver
   ("cancel", consumer_id)
+  ("purge",)                         -- drop all ready messages
   ("down", consumer_id, info)        -- builtin monitor DOWN
 """
 
@@ -32,6 +34,8 @@ class FifoState:
     consumers: "OrderedDict[Any, Dict[int, Any]]" = dataclasses.field(
         default_factory=OrderedDict
     )
+    # consumer_id -> prefetch credit (max in-flight)
+    prefetch: Dict[Any, int] = dataclasses.field(default_factory=dict)
     service_queue: deque = dataclasses.field(default_factory=deque)  # ready consumers
     low_settled_index: int = 0
 
@@ -40,6 +44,7 @@ class FifoState:
             queue=deque(self.queue),
             next_msg_id=self.next_msg_id,
             consumers=OrderedDict((k, dict(v)) for k, v in self.consumers.items()),
+            prefetch=dict(self.prefetch),
             service_queue=deque(self.service_queue),
             low_settled_index=self.low_settled_index,
         )
@@ -64,13 +69,30 @@ class FifoMachine(Machine):
             return st, ("ok", msg_id), effects
         if op == "checkout":
             cid = cmd[1]
+            credit = cmd[2] if len(cmd) > 2 else 1
             if cid not in st.consumers:
                 st.consumers[cid] = {}
                 effects.append(Monitor("process", cid, "machine"))
+            st.prefetch[cid] = max(int(credit), 1)
             if cid not in st.service_queue:
                 st.service_queue.append(cid)
             self._service(st, effects)
             return st, ("ok", None), effects
+        if op == "dequeue":
+            # one-shot take with auto-settlement (the reference's
+            # dequeue/settled checkout mode)
+            if not st.queue:
+                return st, ("ok", None), effects
+            msg_id, msg = st.queue.popleft()
+            if not st.queue and all(not f for f in st.consumers.values()):
+                effects.append(ReleaseCursor(meta["index"], st))
+            return st, ("ok", (msg_id, msg)), effects
+        if op == "purge":
+            n = len(st.queue)
+            st.queue.clear()
+            if all(not f for f in st.consumers.values()):
+                effects.append(ReleaseCursor(meta["index"], st))
+            return st, ("ok", n), effects
         if op == "settle":
             _, cid, msg_id = cmd
             inflight = st.consumers.get(cid, {})
@@ -95,6 +117,7 @@ class FifoMachine(Machine):
             return st, ("ok", None), effects
         if op in ("cancel", "down"):
             cid = cmd[1]
+            st.prefetch.pop(cid, None)
             inflight = st.consumers.pop(cid, None)
             if cid in st.service_queue:
                 st.service_queue.remove(cid)
@@ -106,22 +129,32 @@ class FifoMachine(Machine):
         return state, ("error", "unknown_op")
 
     def _service(self, st: FifoState, effects) -> None:
-        """Deliver queued messages to ready consumers (prefetch 1)."""
+        """Deliver queued messages to ready consumers, up to each
+        consumer's prefetch credit (reference: checkout credit)."""
         while st.queue and st.service_queue:
             cid = st.service_queue[0]
             inflight = st.consumers.get(cid)
             if inflight is None:
                 st.service_queue.popleft()
                 continue
-            if inflight:
+            credit = st.prefetch.get(cid, 1)
+            if len(inflight) >= credit:
                 st.service_queue.popleft()
-                continue  # busy (prefetch 1)
-            msg_id, msg = st.queue.popleft()
-            inflight[msg_id] = msg
-            st.service_queue.popleft()
-            effects.append(
-                SendMsg(cid, ("delivery", msg_id, msg), ("ra_event",))
-            )
+                continue  # at capacity
+            # fill up to credit while messages remain
+            while st.queue and len(inflight) < credit:
+                msg_id, msg = st.queue.popleft()
+                inflight[msg_id] = msg
+                effects.append(
+                    SendMsg(cid, ("delivery", msg_id, msg), ("ra_event",))
+                )
+            if len(inflight) >= credit:
+                # only at capacity does the consumer leave the ready
+                # queue; with spare credit it must keep receiving later
+                # enqueues (the outer loop's queue check terminates)
+                st.service_queue.popleft()
+            else:
+                break  # queue drained; consumer stays ready
 
     def overview(self, state: FifoState):
         return {
@@ -129,4 +162,5 @@ class FifoMachine(Machine):
             "ready": len(state.queue),
             "consumers": len(state.consumers),
             "in_flight": sum(len(f) for f in state.consumers.values()),
+            "prefetch": dict(state.prefetch),
         }

@@ -252,3 +252,61 @@ def test_counters_exposed(cluster3):
     assert key in ov
     assert ov[key]["commands"] >= 3
     assert ov[key]["commit_index"] >= 4
+
+
+def test_fifo_prefetch_dequeue_and_purge(tmp_path):
+    """Reference-workload surface: prefetch credit drives multi-message
+    delivery, dequeue is a one-shot settled take, purge drops ready
+    messages (cf. test/ra_fifo.erl checkout credit / dequeue / purge)."""
+    from ra_tpu.models.fifo import FifoMachine
+
+    m = FifoMachine()
+    st = m.init({})
+
+    def apply(st, cmd, idx=[0]):
+        idx[0] += 1
+        out = m.apply({"index": idx[0], "term": 1}, cmd, st)
+        return out[0], out[1], (out[2] if len(out) > 2 else [])
+
+    for i in range(5):
+        st, _, _ = apply(st, ("enqueue", f"m{i}"))
+    # prefetch 3: checkout delivers three at once
+    st, _, effs = apply(st, ("checkout", "c1", 3))
+    deliveries = [e for e in effs if getattr(e, "msg", None) and e.msg[0] == "delivery"]
+    assert len(deliveries) == 3
+    assert len(st.consumers["c1"]) == 3
+    # dequeue takes the next ready message, auto-settled
+    st, reply, _ = apply(st, ("dequeue", "solo"))
+    assert reply[0] == "ok" and reply[1][1] == "m3"
+    # purge drops the remaining ready message
+    st, reply, _ = apply(st, ("purge",))
+    assert reply == ("ok", 1)
+    assert len(st.queue) == 0
+    # settling frees credit; nothing ready so nothing delivered
+    st, _, _ = apply(st, ("settle", "c1", 1))
+    assert len(st.consumers["c1"]) == 2
+    # empty dequeue is ok/None
+    st, reply, _ = apply(st, ("dequeue", "solo"))
+    assert reply == ("ok", None)
+
+
+def test_fifo_spare_credit_receives_later_enqueues():
+    """A consumer with spare prefetch credit stays in the service queue:
+    enqueues AFTER checkout must flow to it without another op."""
+    from ra_tpu.models.fifo import FifoMachine
+
+    m = FifoMachine()
+    st = m.init({})
+    idx = [0]
+
+    def apply(st, cmd):
+        idx[0] += 1
+        out = m.apply({"index": idx[0], "term": 1}, cmd, st)
+        return out[0], out[1], (out[2] if len(out) > 2 else [])
+
+    st, _, _ = apply(st, ("checkout", "c1", 3))
+    st, _, e1 = apply(st, ("enqueue", "a"))
+    st, _, e2 = apply(st, ("enqueue", "b"))
+    deliveries = [e for e in e1 + e2 if getattr(e, "msg", None) and e.msg[0] == "delivery"]
+    assert len(deliveries) == 2, deliveries
+    assert len(st.consumers["c1"]) == 2
