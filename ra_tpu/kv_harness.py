@@ -162,6 +162,9 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
     model = _Model()
     counts: Dict[str, int] = {}
     partitioned: Optional[str] = None
+    # rescue randomness separate from the workload stream (seed
+    # determinism of the op sequence survives wall-clock rescues)
+    rescue_rng = random.Random(seed ^ 0x5EED)
 
     def heal():
         nonlocal partitioned
@@ -195,7 +198,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                 # the run if service cannot be restored)
                 heal()
                 try:
-                    api.trigger_election(rng.choice(cluster))
+                    api.trigger_election(rescue_rng.choice(cluster))
                 except Exception:  # noqa: BLE001
                     pass
                 consecutive_failures[0] = 0
@@ -337,12 +340,25 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> Harnes
     model = _Model()
     counts: Dict[str, int] = {}
     partitioned: Optional[str] = None
+    consecutive_failures = [0]
+    # rescue randomness is separate from the workload stream: the op
+    # sequence must stay seed-deterministic even though rescues fire on
+    # wall-clock conditions
+    rescue_rng = random.Random(seed ^ 0x5EED)
 
     def heal():
         nonlocal partitioned
         for c in coords.values():
             c.transport.unblock_all()
         partitioned = None
+
+    def kick():
+        """Operator rescue: force an election on a random member."""
+        tgt = rescue_rng.choice(cluster)
+        try:
+            coords[tgt[1]].deliver(tgt, ElectionTimeout(), None)
+        except Exception:  # noqa: BLE001
+            pass
 
     def write(cmd):
         try:
@@ -351,11 +367,19 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> Harnes
                 retry_on_timeout=True,
             )
             model.applied(cmd)
+            consecutive_failures[0] = 0
         except Exception:  # noqa: BLE001
             model.uncertain(cmd)
+            consecutive_failures[0] += 1
 
     try:
         for op_i in range(n_ops):
+            if consecutive_failures[0] >= 4:
+                # operator action on a stuck deployment (same rescue as
+                # the actor harness); final checks still gate the run
+                heal()
+                kick()
+                consecutive_failures[0] = 0
             roll = rng.random()
             key = f"k{rng.randrange(12)}"
             if roll < 0.5:
@@ -407,6 +431,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> Harnes
         heal()
         final = None
         deadline = time.monotonic() + 30
+        kick_at = time.monotonic()
         while time.monotonic() < deadline:
             try:
                 out = api.consistent_query(cluster[0], lambda s: dict(s),
@@ -414,6 +439,11 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> Harnes
                 final = out[1]
                 break
             except Exception:  # noqa: BLE001
+                if time.monotonic() - kick_at > 3:
+                    # operator rescue: force elections until service
+                    # returns (the consistency checks still gate)
+                    kick()
+                    kick_at = time.monotonic()
                 time.sleep(0.2)
         if final is None:
             model.failures.append("no leader after heal: cluster wedged")
@@ -429,7 +459,14 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout) -> Harnes
                 if laggards:
                     time.sleep(0.2)
             for n in laggards:
-                model.failures.append(f"replica {n} never converged")
+                g = coords[n].by_name[gname]
+                model.failures.append(
+                    f"replica {n} never converged: role={g.role} "
+                    f"term={g.term} applied={g.last_applied} "
+                    f"members={g.members} state_keys="
+                    f"{sorted(g.machine_state)[:6]} vs final_keys="
+                    f"{sorted(final)[:6]}"
+                )
     finally:
         for c in coords.values():
             c.stop()

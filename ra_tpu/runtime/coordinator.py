@@ -383,16 +383,26 @@ class BatchCoordinator:
         aer_dirty: set = set()
 
         by_get = self.by_name.get
-        handle_cmd = self._handle_command
         route = self._route_one
+        # commands (the hot ingest type) are grouped per target first:
+        # pipelined waves interleave groups (g0,g1,…,g0,g1,…), so batch
+        # appending per group amortizes the log/run/reply bookkeeping
+        # that per-command handling pays N times
+        cmd_batches: Dict[GroupHost, List[Command]] = {}
         for to_name, from_sid, msg in batch:
             g = by_get(to_name)
             if g is None:
                 continue
-            if type(msg) is Command:  # the hot ingest type
-                handle_cmd(g, msg, appended, written, aer_dirty)
+            if type(msg) is Command:
+                b = cmd_batches.get(g)
+                if b is None:
+                    cmd_batches[g] = [msg]
+                else:
+                    b.append(msg)
             else:
                 route(g, from_sid, msg, rare, appended, written, aer_dirty)
+        for g, cmds in cmd_batches.items():
+            self._handle_commands(g, cmds, appended, written, aer_dirty)
 
         if not (
             batch or self._hot or rare or appended or written
@@ -538,35 +548,50 @@ class BatchCoordinator:
         rare.append((g, msg, from_sid))
 
     def _handle_command(self, g: GroupHost, cmd: Command, appended, written, aer_dirty):
+        self._handle_commands(g, (cmd,), appended, written, aer_dirty)
+
+    def _handle_commands(self, g: GroupHost, cmds, appended, written, aer_dirty):
+        """Append a batch of client commands for one group: one pass of
+        log/run/reply bookkeeping instead of per-command."""
         if g.role != C.R_LEADER:
-            if cmd.from_ref is not None:
-                self._reply(cmd.from_ref, ("redirect", g.sid_of(g.leader_slot)))
+            red = ("redirect", g.sid_of(g.leader_slot))
+            for cmd in cmds:
+                if cmd.from_ref is not None:
+                    self._reply(cmd.from_ref, red)
             return
-        if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
-            if not self._prepare_cluster_cmd(g, cmd):
-                return
         log = g.log
-        idx = log.next_index()
         term = g.term
-        log.append(Entry(idx, term, cmd))
         gid = g.gid
+        pending = g.pending_replies
+        me = (g.name, self.name)
+        idx = log.next_index()
+        first = idx
+        for cmd in cmds:
+            if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                if not self._prepare_cluster_cmd(g, cmd):
+                    continue
+            log.append(Entry(idx, term, cmd))
+            if cmd.from_ref is not None:
+                if cmd.reply_mode == "after_log_append":
+                    self._reply(cmd.from_ref, ("ok", (idx, term), me))
+                elif cmd.reply_mode == "await_consensus":
+                    pending[idx] = cmd.from_ref
+            idx += 1
+        if idx == first:
+            return  # every command was rejected
+        last = idx - 1
         runs = appended.get(gid)
         if runs is None:
-            appended[gid] = [[idx, idx, term]]
+            appended[gid] = [[first, last, term]]
         else:
-            last = runs[-1]
-            if last[1] + 1 == idx and last[2] == term:
-                last[1] = idx
+            tail = runs[-1]
+            if tail[1] + 1 == first and tail[2] == term:
+                tail[1] = last
             else:
-                runs.append([idx, idx, term])
-        if log.last_written()[0] >= idx and written.get(gid, 0) < idx:
-            written[gid] = idx
-        if cmd.from_ref is not None:
-            if cmd.reply_mode == "after_log_append":
-                self._reply(cmd.from_ref, ("ok", (idx, g.term), (g.name, self.name)))
-            elif cmd.reply_mode == "await_consensus":
-                g.pending_replies[idx] = cmd.from_ref
-        aer_dirty.add(g.gid)
+                runs.append([first, last, term])
+        if log.last_written()[0] >= last and written.get(gid, 0) < last:
+            written[gid] = last
+        aer_dirty.add(gid)
 
     # -- membership (reference: $ra_join/$ra_leave handling,
     # src/ra_server.erl:3491-3542; one change in flight at a time) --------
