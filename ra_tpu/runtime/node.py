@@ -79,9 +79,14 @@ class RaNode:
         self.tables = TableRegistry()
         self.scheduler = Scheduler(workers=scheduler_workers)
         self.scheduler.on_crash = self._on_actor_crash
+        # background work gets its OWN scheduler: a disk-heavy
+        # compaction must never occupy a raft worker and starve
+        # mailbox drains (heartbeats, elections)
+        self.bg_scheduler = Scheduler(workers=2)
         self.timers = TimerService()
         self.bg = ThreadPoolExecutor(max_workers=2, thread_name_prefix=f"ra-bg-{name}")
         self.monitors = Monitors()
+        self._bg_actors: Dict[str, Any] = {}  # per-server ordered bg queues
         self.procs: Dict[str, ServerProc] = {}
         self.ra_state: Dict[str, Tuple[str, str, Any]] = {}
         self._client_sinks: Dict[Any, Callable[[ServerId, list], None]] = {}
@@ -201,7 +206,10 @@ class RaNode:
                 self.wal,
                 min_snapshot_interval=self.config.min_snapshot_interval,
                 min_checkpoint_interval=self.config.min_checkpoint_interval,
-                bg_submit=self.bg.submit,  # major compaction off-thread
+                # major compaction passes for one server run in order
+                # on its bg queue (never concurrently with each other)
+                bg_submit=(lambda fn, _uid=uid: self.submit_bg(
+                    fx.BgWork(fn, None), key=_uid)),
                 segment_index_mode=self.config.segment_index_mode,
                 sync_pool=self.sync_pool,
             )
@@ -276,6 +284,9 @@ class RaNode:
             proc = self.procs.pop(name, None)
         if proc is not None:
             proc.kill()
+            bg = self._bg_actors.pop(proc.server.cfg.uid, None)
+            if bg is not None:
+                bg.kill()
             if orderly:
                 # capture AFTER the actor stopped: last_applied and
                 # machine_state must be a coherent pair (a live actor
@@ -489,15 +500,44 @@ class RaNode:
             except Exception:  # noqa: BLE001
                 pass
 
-    def submit_bg(self, eff: fx.BgWork) -> None:
-        def run():
-            try:
-                eff.fn()
-            except BaseException as e:  # noqa: BLE001
-                if eff.err_fn is not None:
-                    eff.err_fn(e)
+    def submit_bg(self, eff: fx.BgWork, key: Optional[str] = None) -> None:
+        """Run background work. With ``key`` (a server uid), jobs for
+        the same key execute STRICTLY IN ORDER on a per-key queue while
+        different keys proceed concurrently — the reference's per-server
+        ra_worker contract (src/ra_worker.erl:12-26). Today the keyed
+        producers are major-compaction passes (so one server's majors
+        never overlap each other) and machine BgWork effects; snapshot
+        writes run inline on the server thread and serialize against
+        compaction through the SegmentSet lock. Keyless jobs use the
+        shared pool."""
+        if key is None:
+            def run():
+                try:
+                    eff.fn()
+                except BaseException as e:  # noqa: BLE001
+                    if eff.err_fn is not None:
+                        eff.err_fn(e)
 
-        self.bg.submit(run)
+            self.bg.submit(run)
+            return
+        actor = self._bg_actors.get(key)
+        if actor is None:
+            def run_batch(batch):
+                for fn, err_fn in batch:
+                    try:
+                        fn()
+                    except BaseException as e:  # noqa: BLE001
+                        if err_fn is not None:
+                            try:
+                                err_fn(e)
+                            except Exception:  # noqa: BLE001
+                                traceback.print_exc()
+                        else:
+                            traceback.print_exc()
+
+            actor = self.bg_scheduler.actor(f"__bg__{key}", run_batch)
+            self._bg_actors[key] = actor
+        actor.send((eff.fn, eff.err_fn))
 
     # ------------------------------------------------------------------
     # failure detection (reference: aten poll-based node suspicion)
@@ -586,6 +626,7 @@ class RaNode:
         self.sync_pool.close()
         self.meta.close()
         self.scheduler.close()
+        self.bg_scheduler.close()
         self.timers.close()
         self.bg.shutdown(wait=False)
         closer = getattr(self.transport, "close", None)

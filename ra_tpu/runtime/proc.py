@@ -404,7 +404,7 @@ class ServerProc:
                 except Exception:  # noqa: BLE001
                     pass
             elif isinstance(eff, fx.BgWork):
-                self.node.submit_bg(eff)
+                self.node.submit_bg(eff, key=self.server.cfg.uid)
             elif isinstance(eff, fx.Monitor):
                 self.node.monitors.add(self.server.id, eff.kind, eff.target, eff.component)
             elif isinstance(eff, fx.Demonitor):

@@ -327,3 +327,52 @@ def test_monitor_down_routed_by_component(tmp_path):
     assert seen["aux"] == [("tgt2", "mdA")]
     api.stop_node("mdA")
     leaderboard.clear()
+
+
+def test_bg_work_per_server_ordering(tmp_path):
+    """Background jobs for one server run strictly in order (snapshot
+    writes / compactions must not reorder); different servers proceed
+    concurrently (reference: per-server ra_worker)."""
+    import threading
+    import time as _time
+
+    from ra_tpu import api, leaderboard, effects as fx
+    from ra_tpu.runtime.transport import registry
+    from ra_tpu.system import SystemConfig
+
+    leaderboard.clear()
+    api.start_node("bgA", SystemConfig(name="bg", data_dir=str(tmp_path)),
+                   election_timeout_s=0.1, tick_interval_s=0.05)
+    node = registry().get("bgA")
+    order = []
+    gate = threading.Event()
+
+    def slow_a():
+        _time.sleep(0.3)
+        order.append("a1")
+
+    def fast_a():
+        order.append("a2")
+
+    def job_b():
+        order.append("b")
+        gate.set()
+
+    node.submit_bg(fx.BgWork(slow_a), key="uid_a")
+    node.submit_bg(fx.BgWork(fast_a), key="uid_a")  # must wait for slow_a
+    node.submit_bg(fx.BgWork(job_b), key="uid_b")   # independent: no wait
+    assert gate.wait(5)
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline and len(order) < 3:
+        _time.sleep(0.02)
+    assert order.index("b") < order.index("a1"), order  # b didn't queue behind a
+    assert order.index("a1") < order.index("a2"), order  # per-key order kept
+    # errors route to err_fn without killing the queue
+    errs = []
+    done = threading.Event()
+    node.submit_bg(fx.BgWork(lambda: 1 / 0, errs.append), key="uid_a")
+    node.submit_bg(fx.BgWork(lambda: done.set()), key="uid_a")
+    assert done.wait(5)
+    assert len(errs) == 1 and isinstance(errs[0], ZeroDivisionError)
+    api.stop_node("bgA")
+    leaderboard.clear()
