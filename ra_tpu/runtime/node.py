@@ -10,10 +10,10 @@ node failure detector, and crash-restart supervision for server procs.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
-import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -33,6 +33,9 @@ from ra_tpu.runtime.timers import TimerService
 from ra_tpu.runtime.transport import InProcTransport, NodeRegistry, registry as node_registry
 from ra_tpu.server import Server, ServerConfig
 from ra_tpu.system import SystemConfig
+
+
+logger = logging.getLogger("ra_tpu")
 
 
 class Monitors:
@@ -416,7 +419,7 @@ class RaNode:
                 )
             except Exception:  # noqa: BLE001 — one bad server must not
                 # block recovery of the rest (or the whole node boot)
-                traceback.print_exc()
+                logger.exception("recovery of server %r skipped", name)
 
     def _write_recovery_checkpoint(self, proc) -> None:
         """Orderly-shutdown capture so the next boot can skip replay
@@ -457,7 +460,7 @@ class RaNode:
             # crashed state is suspect: no recovery checkpoint
             self.restart_server(name, orderly=False)
         except Exception:  # noqa: BLE001
-            traceback.print_exc()
+            logger.exception("supervision: restart of %r failed", name)
 
     # ------------------------------------------------------------------
     # message delivery
@@ -531,9 +534,9 @@ class RaNode:
                             try:
                                 err_fn(e)
                             except Exception:  # noqa: BLE001
-                                traceback.print_exc()
+                                logger.exception("bg err_fn for %r raised", key)
                         else:
-                            traceback.print_exc()
+                            logger.exception("bg job for %r failed", key)
 
             actor = self.bg_scheduler.actor(f"__bg__{key}", run_batch)
             self._bg_actors[key] = actor

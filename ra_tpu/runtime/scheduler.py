@@ -10,9 +10,13 @@ engineered for CPython where a thread per group would not scale).
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import deque
 from typing import Any, Callable, List, Optional
+
+
+logger = logging.getLogger("ra_tpu")
 
 
 class Actor:
@@ -60,9 +64,7 @@ class Actor:
             try:
                 self.on_batch(batch)
             except Exception:  # noqa: BLE001 — actor crash isolation
-                import traceback
-
-                traceback.print_exc()
+                logger.exception("actor %r crashed", self.name)
                 self._sched.on_actor_crash(self)
                 with self._lock:
                     self._scheduled = False

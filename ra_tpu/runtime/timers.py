@@ -11,9 +11,14 @@ from __future__ import annotations
 import heapq
 import itertools
 import random
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
+
+
+logger = logging.getLogger("ra_tpu")
+
 
 
 class TimerService:
@@ -67,9 +72,7 @@ class TimerService:
             try:
                 cb()
             except Exception:  # noqa: BLE001
-                import traceback
-
-                traceback.print_exc()
+                logger.exception("timer callback crashed")
 
     def close(self) -> None:
         with self._cv:
