@@ -54,8 +54,10 @@ class ReadPlan:
                 from ra_tpu.log.segments import SegmentSet
 
                 # fresh read-only view; binary index mode keeps memory
-                # flat for sparse reads over many segments
-                segs = SegmentSet(segdir, index_mode="binary")
+                # flat for sparse reads over many segments. readonly
+                # skips compaction recovery — a caller-side read must
+                # not unlink the owner's in-flight compaction temps.
+                segs = SegmentSet(segdir, index_mode="binary", readonly=True)
                 try:
                     for i in missing:
                         e = segs.fetch(i)

@@ -40,10 +40,17 @@ SYMLINK_KEEP_S = 60.0
 
 
 class SegmentSet:
-    def __init__(self, dir: str, open_cache: int = 8, index_mode: str = "map"):
+    def __init__(
+        self,
+        dir: str,
+        open_cache: int = 8,
+        index_mode: str = "map",
+        readonly: bool = False,
+    ):
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
         self.index_mode = index_mode  # "map" | "binary" (low-memory)
+        self.readonly = readonly
         self._lock = threading.RLock()
         # filename -> (lo, hi) inclusive range
         self.refs: Dict[str, Tuple[int, int]] = {}
@@ -57,7 +64,11 @@ class SegmentSet:
         self._items: List[Tuple[int, int, str]] = []
         self._los: List[int] = []
         self._pmax: List[int] = []
-        self._recover_compaction()
+        # a readonly view (external ReadPlan.execute) must not run crash
+        # recovery: unlinking the owning process's in-flight .compacting
+        # temp or .compaction_group marker would abort its live pass
+        if not readonly:
+            self._recover_compaction()
         for f in sorted(os.listdir(dir)):
             p = os.path.join(dir, f)
             if f.endswith(".segment") and not os.path.islink(p):
@@ -320,13 +331,36 @@ class SegmentSet:
                 cur_count += len(live_idx)
             if len(cur) > 1:
                 groups.append(cur)
+            # the interval index must not outlive the unreferenced-file
+            # deletions above: a concurrent reader resolving an index
+            # through stale items would open an unlinked file (symlinked
+            # names later are fine — they resolve to merged data)
+            self._rebuild_interval_index()
 
-            for grp in groups:
-                self._merge_group(grp, result)
+        # the merges (candidate reads, entry copies, fsyncs) run OUTSIDE
+        # the lock — consensus-path fetch/fetch_term must not block on a
+        # disk-bound pass. The marker/symlink protocol already tolerates
+        # concurrent readers of the old names; the swap step re-takes
+        # the lock and verifies the group is still intact.
+        for grp in groups:
+            built = self._merge_group_build(grp)
+            if built is None:
+                continue
+            tmp, marker, new_range = built
+            with self._lock:
+                self._merge_group_swap(
+                    [f for f, _ in grp], tmp, marker, new_range, result
+                )
+        with self._lock:
             self._rebuild_interval_index()
         return result
 
-    def _merge_group(self, grp, result) -> None:
+    def _merge_group_build(self, grp):
+        """Unlocked phase of one group merge: durable tmp + manifest,
+        then copy live entries via privately-opened readers (the shared
+        FLRU cache is lock-guarded). Returns (tmp, marker, range), or
+        None after rolling back if a group file vanished concurrently
+        (snapshot-floor truncation deleted it)."""
         files = [f for f, _ in grp]
         first = files[0]
         stem = first.split(".")[0]
@@ -354,15 +388,47 @@ class SegmentSet:
 
         # 2. merge all live entries into the .compacting segment
         w = SegmentWriterHandle(tmp, max_count=max(total, 1))
-        for f, live_idx in grp:
-            r = self._reader(f)
-            for i in live_idx:
-                got = r.read(i)
-                if got is not None:
-                    w.append(i, got[0], got[1])
+        try:
+            for f, live_idx in grp:
+                r = SegmentReader(os.path.join(self.dir, f), mode=self.index_mode)
+                try:
+                    for i in live_idx:
+                        got = r.read(i)
+                        if got is not None:
+                            w.append(i, got[0], got[1])
+                finally:
+                    r.close()
+        except (OSError, ValueError):
+            w.close()
+            self._abort_merge(marker, tmp)
+            return None
         w.sync()
         w.close()
-        new_range = w.range
+        return tmp, marker, w.range
+
+    def _abort_merge(self, marker: str, tmp: str) -> None:
+        # marker goes first: a crash between the unlinks must never
+        # leave "marker present + tmp absent", which recovery reads as
+        # a completed rename
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        sync_dir(self.dir)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+    def _merge_group_swap(self, files, tmp, marker, new_range, result) -> None:
+        """Locked phase: verify the group survived, atomic-rename the
+        merged data over the first segment, symlink the rest."""
+        first = files[0]
+        if any(f not in self.refs for f in files):
+            # truncation raced us and removed a member: the originals
+            # (or their deletions) win; discard the merged tmp
+            self._abort_merge(marker, tmp)
+            return
 
         # 3. atomic rename over the FIRST segment (before symlinks, so a
         # reader following a symlink always sees merged data)

@@ -604,6 +604,11 @@ class BatchCoordinator:
             if cmd.from_ref is not None:
                 self._reply(cmd.from_ref, ("error", "cluster_change_not_permitted"))
             return False
+        # rollback point: the leader's own uncommitted change must be
+        # undoable if it is deposed and a new leader truncates this
+        # suffix — same protocol as follower-side _adopt_cluster_cmd
+        # (the truncation rollback in _host_write_entries covers both)
+        history = (g.log.next_index(), list(g.members), dict(g.voter_status))
         if cmd.kind == RA_JOIN:
             member, voter = cmd.data
             member = tuple(member)
@@ -635,6 +640,8 @@ class BatchCoordinator:
                 slot = g.slot_of(tuple(member))
                 if slot >= 0:
                     g.voter_status[slot] = vs
+        g.cluster_history.append(history)
+        del g.cluster_history[:-8]
         g.cluster_change_permitted = False
         g.cluster_index = g.log.next_index()
         self._sync_member_rows(g)
@@ -1359,9 +1366,16 @@ class BatchCoordinator:
             self._handle_consistent_query(g, msg[1], msg[2])
             return
         if isinstance(msg, HeartbeatRpc):
-            # follower side of the query-index leadership confirmation
+            # follower side of the query-index leadership confirmation.
+            # A higher term is adopted before acking (the scalar backend
+            # goes through _update_term, server.py; an ack from a member
+            # that never acknowledged the term would be meaningless).
             if from_sid is not None:
                 if msg.term >= g.term:
+                    if msg.term > g.term or g.role != C.R_FOLLOWER:
+                        self._adopt_term(g, msg.term, leader_sid=from_sid)
+                    elif g.leader_slot < 0:
+                        g.leader_slot = g.slot_of(from_sid)
                     reply = HeartbeatReply(term=msg.term, query_index=msg.query_index)
                 else:
                     reply = HeartbeatReply(term=g.term, query_index=-1)
@@ -1545,7 +1559,41 @@ class BatchCoordinator:
         for node_name, msgs in outbound.items():
             self._send_batch(node_name, msgs)
 
+    def _adopt_term(self, g: GroupHost, term: int, leader_sid=None) -> None:
+        """Adopt a higher term seen outside the device mailbox (call
+        sites hold the state lock): revert to follower on host AND
+        device, persist the term, drop in-flight linearizable reads."""
+        if g.role == C.R_LEADER and g.pending_queries:
+            for q in g.pending_queries:
+                self._reply(q["fut"], ("redirect", None))
+            g.pending_queries = []
+        bumped = term > g.term
+        g.term = max(g.term, term)
+        g.role = C.R_FOLLOWER
+        g.leader_slot = g.slot_of(leader_sid) if leader_sid is not None else -1
+        if bumped and self.meta is not None:
+            # entering a new term clears the durable vote (the device
+            # mailbox path resets voted_for on term bumps identically)
+            uid = f"{g.cluster_name}_{g.name}"
+            self.meta.store(uid, "current_term", g.term)
+            self.meta.store_sync(uid, "voted_for", None)
+        voted = (
+            self.state.voted_for.at[g.gid].set(-1)
+            if bumped else self.state.voted_for
+        )
+        self.state = self.state._replace(
+            current_term=self.state.current_term.at[g.gid].max(term),
+            voted_for=voted,
+            leader_slot=self.state.leader_slot.at[g.gid].set(g.leader_slot),
+            role=self.state.role.at[g.gid].set(C.R_FOLLOWER),
+        )
+
     def _handle_heartbeat_reply(self, g: GroupHost, msg: HeartbeatReply, from_sid) -> None:
+        if msg.term > g.term:
+            # a deposed leader must step down now, not wait for AER
+            # traffic while its pending queries ride the redirect timeout
+            self._adopt_term(g, msg.term)
+            return
         if g.role != C.R_LEADER or from_sid is None or msg.term != g.term:
             return
         slot = g.slot_of(from_sid)

@@ -469,7 +469,13 @@ class ServerProc:
             # replaying them under a later term would double-apply
             # commands the client already resent to the new leader
             # (pipeline commands are at-most-once; clients track
-            # correlations)
+            # correlations). A buffered command with a reply future must
+            # hear the redirect, not hang until timeout.
+            leader = self.server.leader_id
+            for cmd in self._low_q:
+                fut = getattr(cmd, "from_ref", None)
+                if fut is not None:
+                    self._reply(fut, ("redirect", leader))
             self._low_q.clear()
         if role in (PRE_VOTE, CANDIDATE):
             self.arm_election_timer()  # retry a stalled election round

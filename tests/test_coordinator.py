@@ -389,3 +389,112 @@ def test_coordinator_reloads_term_and_vote_from_meta(tmp_path):
         c2.stop()
         leaderboard.clear()
         meta.close()
+
+
+def _partition_coord(coords, isolated):
+    """Bidirectionally block traffic between `isolated` and the rest."""
+    for c in coords:
+        if c.name == isolated:
+            for other in coords:
+                if other.name != isolated:
+                    c.transport.block(c.name, other.name)
+        else:
+            c.transport.block(c.name, isolated)
+
+
+def _heal_coords(coords):
+    for c in coords:
+        c.transport.unblock_all()
+
+
+def test_leader_rolls_back_uncommitted_cluster_change():
+    """ADVICE r2 (medium): a deposed leader whose own uncommitted
+    RA_LEAVE is truncated by the new leader must restore its member
+    table and voter rows — _prepare_cluster_cmd records the same
+    rollback history as follower-side adoption."""
+    leaderboard.clear()
+    coords = [BatchCoordinator(f"rb{i}", capacity=8, num_peers=3,
+                               election_timeout_s=0.1, detector_poll_s=0.05)
+              for i in range(3)]
+    for c in coords:
+        c.start()
+    try:
+        ids = [("rg", f"rb{i}") for i in range(3)]
+        for c in coords:
+            c.add_group("rg", "rbc", ids, adder())
+        coords[0].deliver(ids[0], ElectionTimeout(), None)
+        await_(lambda: coords[0].by_name["rg"].role == C.R_LEADER,
+               what="rb0 leads")
+        fut = api.Future()
+        coords[0].deliver(ids[0], Command(kind=USR, data=1,
+                                          reply_mode="await_consensus",
+                                          from_ref=fut), None)
+        assert fut.result(5)[0] == "ok"
+        # isolate the leader, then ask it to drop rb2 — the change
+        # mutates its host member table immediately but can never commit
+        _partition_coord(coords, "rb0")
+        from ra_tpu.protocol import RA_LEAVE
+
+        g0 = coords[0].by_name["rg"]
+        coords[0].deliver(ids[0], Command(kind=RA_LEAVE, data=ids[2]), None)
+        await_(lambda: g0.members[2] is None, what="leave applied on host")
+        assert g0.voter_status.get(2) is None
+        # a new leader rises on the majority side and appends its noop
+        # over the orphaned RA_LEAVE suffix
+        coords[1].deliver(ids[1], ElectionTimeout(), None)
+        await_(lambda: coords[1].by_name["rg"].role == C.R_LEADER,
+               what="rb1 takes over")
+        _heal_coords(coords)
+        # healing: rb0 steps down, truncates, and must ROLL BACK the
+        # member table to the full 3-member config
+        await_(lambda: g0.role != C.R_LEADER, what="rb0 deposed")
+        await_(lambda: g0.members[2] == ids[2] and
+               g0.voter_status.get(2) == "voter",
+               what="member table rolled back")
+        # the restored cluster still commits through all three members
+        fut2 = api.Future()
+        coords[1].deliver(ids[1], Command(kind=USR, data=2,
+                                          reply_mode="await_consensus",
+                                          from_ref=fut2), None)
+        assert fut2.result(5)[0] == "ok"
+        await_(lambda: g0.machine_state == 3, what="rb0 converges")
+    finally:
+        for c in coords:
+            c.stop()
+        leaderboard.clear()
+
+
+def test_heartbeat_adopts_term_and_steps_down_stale_leader():
+    """ADVICE r2 (low): a batch follower seeing a higher-term
+    HeartbeatRpc adopts the term before acking, and a deposed leader
+    receiving a higher-term HeartbeatReply steps down immediately."""
+    import numpy as np
+    from ra_tpu.protocol import HeartbeatRpc, HeartbeatReply
+
+    leaderboard.clear()
+    c = BatchCoordinator("hb1", capacity=8, num_peers=3)
+    c.start()
+    try:
+        ids = [("hg", "hb1"), ("hg", "hbX"), ("hg", "hbY")]
+        c.add_group("hg", "hbc", ids, adder())
+        g = c.by_name["hg"]
+        # follower side: higher-term heartbeat is adopted
+        c.deliver(ids[0], HeartbeatRpc(term=7, leader_id=ids[1], query_index=1),
+                  ids[1])
+        await_(lambda: g.term == 7, what="term adopted from heartbeat")
+        assert g.leader_slot == 1
+        await_(lambda: int(np.asarray(c.state.current_term)[g.gid]) == 7,
+               what="device term adopted")
+        assert int(np.asarray(c.state.voted_for)[g.gid]) == -1
+        # leader side: a higher-term reply deposes
+        c.deliver(ids[0], ElectionTimeout(), None)
+        # (single reachable member can't win quorum; force the role via
+        # the device path by checking it left follower, then feed the
+        # higher-term reply through the leader handler directly)
+        g.role = C.R_LEADER
+        c.deliver(ids[0], HeartbeatReply(term=11, query_index=1), ids[1])
+        await_(lambda: g.role == C.R_FOLLOWER and g.term == 11,
+               what="stale leader stepped down")
+    finally:
+        c.stop()
+        leaderboard.clear()

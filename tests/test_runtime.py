@@ -376,3 +376,22 @@ def test_bg_work_per_server_ordering(tmp_path):
     assert len(errs) == 1 and isinstance(errs[0], ZeroDivisionError)
     api.stop_node("bgA")
     leaderboard.clear()
+
+
+def test_low_priority_commands_redirected_on_leadership_loss(cluster):
+    """ADVICE r2 (low): a buffered low-priority command holding a reply
+    future must hear ('redirect', leader) when leadership is lost, not
+    hang until its caller times out."""
+    from ra_tpu.protocol import Command, USR
+
+    leader = api.wait_for_leader("add")
+    node = registry().get(leader[1])
+    proc = node.procs[leader[0]]
+    fut = api.Future()
+    # buffer a low directly (the drain runs only between main-queue
+    # batches; state transitions clear the lane)
+    proc._low_q.append(Command(kind=USR, data=1, reply_mode="await_consensus",
+                               from_ref=fut, priority="low"))
+    proc._on_state_enter("follower")
+    out = fut.result(2)
+    assert out[0] == "redirect"

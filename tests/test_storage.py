@@ -642,6 +642,29 @@ def test_major_compaction_crash_after_rename_recreates_symlinks(tmp_path):
     segs2.close()
 
 
+def test_readonly_segmentset_preserves_compaction_markers(tmp_path):
+    """ADVICE r2 (low): an external ReadPlan-style readonly view must
+    not run compaction crash recovery — unlinking the owner's live
+    .compacting temp or .compaction_group marker would abort its
+    in-flight major pass."""
+    d, last = _mk_sparse_segments(tmp_path, n_segs=2, per_seg=8)
+    marker = os.path.join(d, "00000001.compaction_group")
+    tmp = os.path.join(d, "00000001.compacting")
+    with open(marker, "wb") as m:
+        pickle.dump(["00000001.segment", "00000002.segment"], m)
+    open(tmp, "wb").close()
+    ro = SegmentSet(d, readonly=True)
+    # the in-flight protocol files survive a readonly open...
+    assert os.path.exists(marker) and os.path.exists(tmp)
+    # ...and reads still work
+    assert ro.fetch(1) is not None and ro.fetch(9) is not None
+    ro.close()
+    # a writable open (the owner restarting) still recovers
+    segs = SegmentSet(d)
+    assert not os.path.exists(marker) and not os.path.exists(tmp)
+    segs.close()
+
+
 def test_kv_style_churn_file_count_plateaus(tmp_path):
     """Live-index workload (log-as-value-store): keys written long ago
     stay live forever, leaving a trail of sparse segments. Minor
