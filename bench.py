@@ -92,11 +92,14 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
     ]
     try:
         members = lambda g: [(f"g{g}", f"bench{i}") for i in range(3)]  # noqa: E731
-        for g in range(groups):
-            for c in coords:
-                c.add_group(
-                    f"g{g}", f"cl{g}", members(g), SimpleMachine(lambda x, s: s + x, 0)
-                )
+        for c in coords:
+            c.add_groups(
+                [
+                    (f"g{g}", f"cl{g}", members(g),
+                     SimpleMachine(lambda x, s: s + x, 0))
+                    for g in range(groups)
+                ]
+            )
         coords[0].deliver_many(
             [((f"g{g}", "bench0"), ElectionTimeout(), None) for g in range(groups)]
         )

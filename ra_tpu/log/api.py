@@ -33,6 +33,13 @@ class LogApi:
         gaps (crash-on-integrity-error, cf. src/ra_log.erl:541-545)."""
         raise NotImplementedError
 
+    def append_many(self, entries: Sequence[Entry]) -> None:
+        """Leader bulk append of a contiguous run starting at
+        next_index(). Implementations may override with a single-pass
+        version; the default loops ``append``."""
+        for e in entries:
+            self.append(e)
+
     def write(self, entries: Sequence[Entry]) -> None:
         """Follower write; may rewind/overwrite a divergent suffix."""
         raise NotImplementedError

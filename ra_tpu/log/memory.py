@@ -7,6 +7,14 @@ immediately; with ``auto_written=False`` the test (or in-proc runtime)
 must drain ``pending_written_events()`` and feed them back through
 ``handle_event`` to advance the watermark — exactly how the real WAL's
 written notifications behave.
+
+Storage layout: the contiguous tail lives in a plain Python list
+(``_list`` holds indexes ``[_base, _base+len)``), so the hot paths —
+bulk append, ``fetch_range`` for AER construction and the apply loop —
+are C-level ``extend``/slice operations instead of per-entry dict
+traffic. Rare out-of-window entries (live entries kept below a
+snapshot floor, sparse writes during snapshot install) go to the
+``_sparse`` dict.
 """
 
 from __future__ import annotations
@@ -20,7 +28,9 @@ from ra_tpu.utils.seq import Seq
 
 class MemoryLog(LogApi):
     def __init__(self, auto_written: bool = True):
-        self.entries: Dict[int, Entry] = {}
+        self._base = 1  # index of _list[0]
+        self._list: List[Entry] = []  # contiguous run [_base, _base+len)
+        self._sparse: Dict[int, Entry] = {}  # out-of-window entries
         self._last_index = 0
         self._last_term = 0
         self._written_index = 0
@@ -38,7 +48,17 @@ class MemoryLog(LogApi):
             raise ValueError(
                 f"non-contiguous append: {entry.index} after {self._last_index}"
             )
-        self._store(entry)
+        self._store_run((entry,))
+
+    def append_many(self, entries: Sequence[Entry]) -> None:
+        if not entries:
+            return
+        if entries[0].index != self._last_index + 1:
+            raise ValueError(
+                f"non-contiguous append: {entries[0].index} after "
+                f"{self._last_index}"
+            )
+        self._store_run(entries)
 
     def write(self, entries: Sequence[Entry]) -> None:
         if not entries:
@@ -50,21 +70,43 @@ class MemoryLog(LogApi):
             # Overwrite: truncate divergent suffix, rewind watermark
             # (cf. src/ra_log.erl:560-580 last_written rewind).
             self.set_last_index(first - 1)
-        for e in entries:
-            self._store(e)
+        self._store_run(entries)
 
-    def _store(self, e: Entry) -> None:
-        self.entries[e.index] = e
-        self._last_index = e.index
-        self._last_term = e.term
+    def _store_run(self, entries: Sequence[Entry]) -> None:
+        """One-pass store of a contiguous run starting at
+        ``_last_index + 1`` (callers validated the head)."""
+        first = entries[0].index
+        lst = self._list
+        if not lst:
+            self._base = first
+        elif first != self._base + len(lst):
+            # the contiguous window does not reach first (possible only
+            # after sparse writes beyond the tail): spill the window to
+            # the sparse map and restart it at first
+            for e in lst:
+                self._sparse[e.index] = e
+            lst.clear()
+            self._base = first
+        lst.extend(entries)
+        last = entries[-1]
+        self._last_index = last.index
+        self._last_term = last.term
         if self.auto_written:
-            self._written_index = e.index
-            self._written_term = e.term
+            self._written_index = last.index
+            self._written_term = last.term
         else:
-            self._pending = self._pending.add(e.index)
+            for e in entries:
+                self._pending = self._pending.add(e.index)
 
     def write_sparse(self, entry: Entry) -> None:
-        self.entries[entry.index] = entry
+        off = entry.index - self._base
+        lst = self._list
+        if 0 <= off < len(lst):
+            lst[off] = entry
+        elif off == len(lst) and (lst or entry.index == self._base):
+            lst.append(entry)
+        else:
+            self._sparse[entry.index] = entry
         if entry.index > self._last_index:
             self._last_index = entry.index
             self._last_term = entry.term
@@ -73,8 +115,13 @@ class MemoryLog(LogApi):
                 self._written_term = entry.term
 
     def set_last_index(self, idx: int) -> None:
-        for i in range(idx + 1, self._last_index + 1):
-            self.entries.pop(i, None)
+        cut = idx - self._base + 1
+        if cut < 0:
+            cut = 0
+        del self._list[cut:]
+        if self._sparse:
+            for i in [k for k in self._sparse if k > idx]:
+                del self._sparse[i]
         self._last_index = idx
         t = self.fetch_term(idx)
         self._last_term = t if t is not None else 0
@@ -94,7 +141,7 @@ class MemoryLog(LogApi):
         cur_term = None
         cur: List[int] = []
         for idx in self._pending:
-            e = self.entries.get(idx)
+            e = self.fetch(idx)
             if e is None:
                 continue
             if cur_term is None or e.term == cur_term:
@@ -118,7 +165,7 @@ class MemoryLog(LogApi):
                 return []
             # Only advance if the entry we wrote is still the one in the
             # log at that index (it may have been overwritten since).
-            e = self.entries.get(last)
+            e = self.fetch(last)
             if e is not None and e.term == term and last > self._written_index:
                 self._written_index = last
                 self._written_term = term
@@ -137,10 +184,14 @@ class MemoryLog(LogApi):
         return self._first_index
 
     def fetch(self, idx: int) -> Optional[Entry]:
-        return self.entries.get(idx)
+        off = idx - self._base
+        lst = self._list
+        if 0 <= off < len(lst):
+            return lst[off]
+        return self._sparse.get(idx)
 
     def fetch_term(self, idx: int) -> Optional[int]:
-        e = self.entries.get(idx)
+        e = self.fetch(idx)
         if e is not None:
             return e.term
         if self._snapshot is not None and idx == self._snapshot[0].index:
@@ -151,24 +202,45 @@ class MemoryLog(LogApi):
 
     def fold(self, lo: int, hi: int, fn: Callable[[Entry, Any], Any], acc: Any) -> Any:
         for i in range(lo, hi + 1):
-            e = self.entries.get(i)
+            e = self.fetch(i)
             if e is None:
                 raise KeyError(f"missing log entry {i}")
             acc = fn(e, acc)
         return acc
 
     def fetch_range(self, lo: int, hi: int) -> List[Entry]:
-        get = self.entries.get
+        """Entries lo..hi inclusive, stopping at the first missing index
+        (same contract as the file-backed log)."""
+        off = lo - self._base
+        lst = self._list
+        if 0 <= off < len(lst):
+            out = lst[off : hi - self._base + 1]
+            nxt = lo + len(out)
+            if nxt <= hi and self._sparse:
+                # window ended before hi: continue through sparse runs
+                fetch = self._sparse.get
+                for i in range(nxt, hi + 1):
+                    e = fetch(i)
+                    if e is None:
+                        break
+                    out.append(e)
+            return out
         out: List[Entry] = []
+        fetch = self.fetch
         for i in range(lo, hi + 1):
-            e = get(i)
+            e = fetch(i)
             if e is None:
                 break
             out.append(e)
         return out
 
     def sparse_read(self, idxs: Sequence[int]) -> List[Entry]:
-        return [self.entries[i] for i in idxs if i in self.entries]
+        out = []
+        for i in idxs:
+            e = self.fetch(i)
+            if e is not None:
+                out.append(e)
+        return out
 
     # -- snapshots ---------------------------------------------------------
 
@@ -184,9 +256,22 @@ class MemoryLog(LogApi):
     def install_snapshot(self, meta: SnapshotMeta, machine_state: Any) -> List[Any]:
         self._snapshot = (meta, machine_state)
         live = set(meta.live_indexes)
-        for i in list(self.entries):
-            if i <= meta.index and i not in live:
-                del self.entries[i]
+        lst = self._list
+        cut = meta.index - self._base + 1
+        if cut > 0:
+            cut = min(cut, len(lst))
+            for e in lst[:cut]:
+                if e.index in live:
+                    self._sparse[e.index] = e
+            del lst[:cut]
+            self._base = meta.index + 1
+        elif not lst:
+            self._base = meta.index + 1
+        if self._sparse:
+            for i in [
+                k for k in self._sparse if k <= meta.index and k not in live
+            ]:
+                del self._sparse[i]
         self._first_index = meta.index + 1
         if self._last_index < meta.index:
             self._last_index = meta.index

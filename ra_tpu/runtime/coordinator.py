@@ -104,7 +104,8 @@ class GroupHost:
         "voter_status", "cluster_change_permitted", "cluster_index",
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
-        "last_ack", "aux_state", "aux_inited",
+        "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
+        "specials",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -173,6 +174,22 @@ class GroupHost:
         # aux machine state (initialized lazily on first aux message)
         self.aux_state: Any = None
         self.aux_inited = False
+        # monotonic time of the last leader contact (AER / heartbeat /
+        # snapshot chunk). The leader's silent-peer resync probe runs
+        # every 2 ticks, so on this backend "no contact for several
+        # ticks" is a reliable leaderless signal — the detector uses it
+        # to retry elections after partition heals (a stalled pre-vote
+        # or a deposed-leader cluster would otherwise wedge forever)
+        self.last_contact = time.monotonic()
+        # buffered low-priority commands, drained in bounded slices
+        # after normal traffic (reference: ra_ets_queue lane,
+        # src/ra_server_proc.erl:507-530)
+        self.low_q: deque = deque()
+        # ascending log indexes holding non-USR commands (noops, cluster
+        # changes). Tracked at append/write time so the apply loop can
+        # take the batched fast path without scanning every entry; kept
+        # exhaustive by the truncation/snapshot paths.
+        self.specials: List[int] = []
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -226,6 +243,13 @@ class BatchCoordinator:
 
         self._ingress: deque = deque()
         self._ingress_cv = threading.Condition()
+        # client commands bypass the generic ingress: they are routed to
+        # per-group lists at DELIVERY time (same lock round), so the
+        # step drain iterates groups instead of re-classifying every
+        # message — at 10k groups x pipelined waves the regrouping pass
+        # was a top-3 hot spot
+        self._cmd_q: Dict[str, List[Command]] = {}
+        self._low_dirty: set = set()  # gids with buffered low-priority cmds
         # ("a", gid, lo, hi, term) appended runs | ("w", gid, idx) durable
         self._pending_scatters: List[Tuple] = []
         # role transitions queued by rare paths, applied as ONE scatter
@@ -266,19 +290,53 @@ class BatchCoordinator:
         if g is None:
             return False
         with self._ingress_cv:
-            self._ingress.append((to[0], from_sid, msg))
+            if type(msg) is Command:
+                self._enqueue_cmd(to[0], g, msg)
+            else:
+                self._ingress.append((to[0], from_sid, msg))
             self._ingress_cv.notify()
         return True
+
+    def _enqueue_cmd(self, name: str, g: Optional[GroupHost], msg: Command) -> None:
+        """Route one client command (caller holds the ingress lock)."""
+        if msg.priority == "low":
+            if g is None:
+                g = self.by_name.get(name)
+            if g is not None:
+                g.low_q.append(msg)
+                self._low_dirty.add(g.gid)
+            return
+        q = self._cmd_q.get(name)
+        if q is None:
+            self._cmd_q[name] = [msg]
+        else:
+            q.append(msg)
 
     def deliver_many(self, msgs) -> None:
         """Batch ingress: one lock round for many ``(to_sid, msg,
         from_sid)`` triples (unknown group names are dropped, as in
         ``deliver``)."""
         by = self.by_name
+        ingress = self._ingress
         with self._ingress_cv:
-            self._ingress.extend(
-                (to[0], frm, m) for to, m, frm in msgs if to[0] in by
-            )
+            # _cmd_q must be read under the lock — the step thread swaps
+            # it out during its drain
+            cq = self._cmd_q
+            for to, m, frm in msgs:
+                name = to[0]
+                if type(m) is Command:
+                    # inlined _enqueue_cmd normal path (hot: one call
+                    # per pipelined command)
+                    if m.priority == "low":
+                        self._enqueue_cmd(name, None, m)
+                        continue
+                    q = cq.get(name)
+                    if q is None:
+                        cq[name] = [m]
+                    else:
+                        q.append(m)
+                elif name in by:
+                    ingress.append((name, frm, m))
             self._ingress_cv.notify()
 
     # -- lifecycle ---------------------------------------------------------
@@ -308,50 +366,96 @@ class BatchCoordinator:
         machine: Machine,
         log: Optional[LogApi] = None,
     ) -> ServerId:
-        if len(members) > self.P:
-            raise ValueError(f"group has {len(members)} members; capacity is {self.P}")
-        if self.n_groups >= self.capacity:
+        return self.add_groups([(name, cluster_name, members, machine, log)])[0]
+
+    def add_groups(self, specs) -> List[ServerId]:
+        """Bulk group registration: ONE set of device scatters for the
+        whole batch. ``specs`` rows are ``(name, cluster_name, members,
+        machine[, log])``. Registering 10k groups one scatter-set at a
+        time was minutes of un-jitted dispatch; this is 5 scatters
+        total."""
+        specs = list(specs)
+        # validate EVERYTHING before mutating: a mid-batch error must
+        # not leave half-registered groups with inactive device rows
+        if self.n_groups + len(specs) > self.capacity:
             raise RuntimeError("coordinator at capacity")
-        sid = (name, self.name)
-        if sid not in members:
-            raise ValueError("members must include this coordinator's server id")
-        gid = self.n_groups
-        self.n_groups += 1
-        g = GroupHost(
-            gid, name, cluster_name, members, members.index(sid),
-            log or MemoryLog(auto_written=True), machine,
-        )
-        self.groups[gid] = g
-        # restart safety: reload the durable term/vote so this member
-        # cannot re-vote in a term it already voted in
-        term0, voted_slot = 0, -1
-        if self.meta is not None:
-            uid = f"{cluster_name}_{name}"
-            term0 = int(self.meta.fetch(uid, "current_term", 0))
-            voted_sid = self.meta.fetch(uid, "voted_for", None)
-            if voted_sid is not None:
-                voted_slot = g.slot_of(tuple(voted_sid))
-                if voted_slot < 0:
-                    # we voted this term for a sid not in the current
-                    # member table (e.g. removed since): seed an
-                    # out-of-range slot so free_to_vote stays False for
-                    # the rest of the term — never degrade to "never
-                    # voted" (-1), which would allow a second grant
-                    voted_slot = self.P
-            g.term = term0
-        # activate slots on device
-        active = np.zeros(self.P, dtype=bool)
-        active[: len(members)] = True
-        with self._state_lock:
-            self.state = self.state._replace(
-                active=self.state.active.at[gid].set(jnp.asarray(active)),
-                voting=self.state.voting.at[gid].set(jnp.asarray(active)),
-                self_slot=self.state.self_slot.at[gid].set(g.self_slot),
-                current_term=self.state.current_term.at[gid].set(term0),
-                voted_for=self.state.voted_for.at[gid].set(voted_slot),
+        for spec in specs:
+            name, _cl, members = spec[0], spec[1], spec[2]
+            if len(members) > self.P:
+                raise ValueError(
+                    f"group has {len(members)} members; capacity is {self.P}"
+                )
+            if (name, self.name) not in members:
+                raise ValueError(
+                    "members must include this coordinator's server id"
+                )
+        sids: List[ServerId] = []
+        hosts: List[Tuple[str, GroupHost]] = []
+        rows: List[Tuple[int, np.ndarray, int, int, int]] = []
+        for k, spec in enumerate(specs):
+            name, cluster_name, members, machine = spec[:4]
+            log = spec[4] if len(spec) > 4 else None
+            sid = (name, self.name)
+            gid = self.n_groups + k
+            g = GroupHost(
+                gid, name, cluster_name, members, members.index(sid),
+                log or MemoryLog(auto_written=True), machine,
             )
-        self.by_name[name] = g
-        return sid
+            # restart safety: reload the durable term/vote so this
+            # member cannot re-vote in a term it already voted in
+            term0, voted_slot = 0, -1
+            if self.meta is not None:
+                uid = f"{cluster_name}_{name}"
+                term0 = int(self.meta.fetch(uid, "current_term", 0))
+                voted_sid = self.meta.fetch(uid, "voted_for", None)
+                if voted_sid is not None:
+                    voted_slot = g.slot_of(tuple(voted_sid))
+                    if voted_slot < 0:
+                        # we voted this term for a sid not in the
+                        # current member table (e.g. removed since):
+                        # seed an out-of-range slot so free_to_vote
+                        # stays False for the rest of the term — never
+                        # degrade to "never voted" (-1), which would
+                        # allow a second grant
+                        voted_slot = self.P
+                g.term = term0
+            active = np.zeros(self.P, dtype=bool)
+            active[: len(members)] = True
+            li, _ = g.log.last_index_term()
+            snap0 = g.log.snapshot_index_term()
+            fi = snap0[0] + 1 if snap0 else 1
+            if li >= fi:
+                # a pre-populated log (cold restart with a persistent
+                # log): seed the specials index so the batched apply
+                # fast path stays sound
+                g.specials = [
+                    e.index for e in g.log.fetch_range(fi, li)
+                    if type(e.cmd) is not Command or e.cmd.kind != USR
+                ]
+            rows.append((gid, active, g.self_slot, term0, voted_slot))
+            hosts.append((name, g))
+            sids.append(sid)
+        if rows:
+            gids = jnp.asarray(np.array([r[0] for r in rows], np.int32))
+            act = jnp.asarray(np.stack([r[1] for r in rows]))
+            slots = jnp.asarray(np.array([r[2] for r in rows], np.int32))
+            terms = jnp.asarray(np.array([r[3] for r in rows], np.int32))
+            voted = jnp.asarray(np.array([r[4] for r in rows], np.int32))
+            with self._state_lock:
+                self.state = self.state._replace(
+                    active=self.state.active.at[gids].set(act),
+                    voting=self.state.voting.at[gids].set(act),
+                    self_slot=self.state.self_slot.at[gids].set(slots),
+                    current_term=self.state.current_term.at[gids].set(terms),
+                    voted_for=self.state.voted_for.at[gids].set(voted),
+                )
+        # publish only after the device rows are live: deliver() must
+        # never accept traffic for a group with inactive rows
+        for name, g in hosts:
+            self.groups[g.gid] = g
+            self.by_name[name] = g
+        self.n_groups += len(hosts)
+        return sids
 
     # -- the step loop -----------------------------------------------------
 
@@ -360,7 +464,7 @@ class BatchCoordinator:
             worked = self.step_once()
             if not worked:
                 with self._ingress_cv:
-                    if not self._ingress:
+                    if not (self._ingress or self._cmd_q or self._low_dirty):
                         self._ingress_cv.wait(timeout=0.05)
 
     def step_once(self) -> bool:
@@ -374,6 +478,9 @@ class BatchCoordinator:
         with self._ingress_cv:
             batch = list(self._ingress)
             self._ingress.clear()
+            cmd_q = self._cmd_q
+            if cmd_q:
+                self._cmd_q = {}
         rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = []
         # appended runs: gid -> [[lo, hi, term], ...] (contiguous,
         # same-term); written: gid -> max durable idx. Run-based so the
@@ -384,28 +491,21 @@ class BatchCoordinator:
 
         by_get = self.by_name.get
         route = self._route_one
-        # commands (the hot ingest type) are grouped per target first:
-        # pipelined waves interleave groups (g0,g1,…,g0,g1,…), so batch
-        # appending per group amortizes the log/run/reply bookkeeping
-        # that per-command handling pays N times
-        cmd_batches: Dict[GroupHost, List[Command]] = {}
         for to_name, from_sid, msg in batch:
             g = by_get(to_name)
             if g is None:
                 continue
-            if type(msg) is Command:
-                b = cmd_batches.get(g)
-                if b is None:
-                    cmd_batches[g] = [msg]
-                else:
-                    b.append(msg)
-            else:
-                route(g, from_sid, msg, rare, appended, written, aer_dirty)
-        for g, cmds in cmd_batches.items():
-            self._handle_commands(g, cmds, appended, written, aer_dirty)
+            route(g, from_sid, msg, rare, appended, written, aer_dirty)
+        # commands were pre-grouped per target at delivery time
+        for name, cmds in cmd_q.items():
+            g = by_get(name)
+            if g is not None:
+                self._handle_commands(g, cmds, appended, written, aer_dirty)
+        if self._low_dirty:
+            self._drain_low_lane(appended, written, aer_dirty)
 
         if not (
-            batch or self._hot or rare or appended or written
+            batch or cmd_q or self._hot or rare or appended or written
             or self._pending_scatters or self._pending_roles
         ):
             return False
@@ -487,13 +587,15 @@ class BatchCoordinator:
     # -- ingress routing ---------------------------------------------------
 
     def _route_one(self, g: GroupHost, from_sid, msg, rare, appended, written, aer_dirty):
-        if isinstance(msg, FromPeer):
+        if type(msg) is FromPeer:
             from_sid, msg = msg.peer, msg.msg
         t = type(msg)
         if t in MSG_OF_TYPE:
+            if t is AppendEntriesRpc and msg.term >= g.term:
+                g.last_contact = time.monotonic()
             # host-side next_index bookkeeping rides on the same replies
             # the device will process
-            if isinstance(msg, AppendEntriesReply) and g.role == C.R_LEADER:
+            elif t is AppendEntriesReply and g.role == C.R_LEADER:
                 slot = g.slot_of(from_sid)
                 if slot >= 0:
                     g.last_ack[slot] = time.monotonic()
@@ -550,6 +652,44 @@ class BatchCoordinator:
     def _handle_command(self, g: GroupHost, cmd: Command, appended, written, aer_dirty):
         self._handle_commands(g, (cmd,), appended, written, aer_dirty)
 
+    # max low-priority commands appended per group per step (reference:
+    # ?FLUSH_COMMANDS_SIZE, src/ra_server.hrl:34)
+    FLUSH_COMMANDS_SIZE = 16
+
+    def _drain_low_lane(self, appended, written, aer_dirty) -> None:
+        """Bounded per-step drain of buffered low-priority commands —
+        normal ingest always goes first; lows trickle in slices so a
+        low-priority firehose cannot starve interactive traffic
+        (reference: ra_ets_queue lane, src/ra_server_proc.erl:507-530).
+        Non-leaders redirect buffered lows instead of dropping futures."""
+        with self._ingress_cv:
+            # delivery threads add to _low_dirty under this lock; swap
+            # it out so iteration never races a concurrent add
+            dirty = self._low_dirty
+            self._low_dirty = set()
+        still: set = set()
+        for gid in dirty:
+            g = self.groups[gid]
+            if g is None or not g.low_q:
+                continue
+            if g.role != C.R_LEADER:
+                red = ("redirect", g.sid_of(g.leader_slot))
+                for cmd in g.low_q:
+                    if cmd.from_ref is not None:
+                        self._reply(cmd.from_ref, red)
+                g.low_q.clear()
+                continue
+            take = [
+                g.low_q.popleft()
+                for _ in range(min(self.FLUSH_COMMANDS_SIZE, len(g.low_q)))
+            ]
+            self._handle_commands(g, take, appended, written, aer_dirty)
+            if g.low_q:
+                still.add(gid)
+        if still:
+            with self._ingress_cv:
+                self._low_dirty |= still
+
     def _handle_commands(self, g: GroupHost, cmds, appended, written, aer_dirty):
         """Append a batch of client commands for one group: one pass of
         log/run/reply bookkeeping instead of per-command."""
@@ -566,17 +706,32 @@ class BatchCoordinator:
         me = (g.name, self.name)
         idx = log.next_index()
         first = idx
+        # fast path: plain user commands owing no replies (the pipeline
+        # shape) — build the run in one pass and bulk-append it
+        simple = True
         for cmd in cmds:
-            if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
-                if not self._prepare_cluster_cmd(g, cmd):
-                    continue
-            log.append(Entry(idx, term, cmd))
-            if cmd.from_ref is not None:
-                if cmd.reply_mode == "after_log_append":
-                    self._reply(cmd.from_ref, ("ok", (idx, term), me))
-                elif cmd.reply_mode == "await_consensus":
-                    pending[idx] = cmd.from_ref
-            idx += 1
+            if cmd.kind != USR or cmd.from_ref is not None:
+                simple = False
+                break
+        if simple:
+            log.append_many(
+                [Entry(first + k, term, cmd) for k, cmd in enumerate(cmds)]
+            )
+            idx = first + len(cmds)
+        else:
+            for cmd in cmds:
+                if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                    if not self._prepare_cluster_cmd(g, cmd):
+                        continue
+                log.append(Entry(idx, term, cmd))
+                if cmd.kind != USR:
+                    g.specials.append(idx)
+                if cmd.from_ref is not None:
+                    if cmd.reply_mode == "after_log_append":
+                        self._reply(cmd.from_ref, ("ok", (idx, term), me))
+                    elif cmd.reply_mode == "await_consensus":
+                        pending[idx] = cmd.from_ref
+                idx += 1
         if idx == first:
             return  # every command was rejected
         last = idx - 1
@@ -731,26 +886,68 @@ class BatchCoordinator:
     def _build_mailbox(self):
         cap = self.capacity
         packed = np.zeros((len(C.MBOX_FIELDS), cap), np.int32)
-        packed[self._R["host_term_idx"]].fill(-1)
-        packed[self._R["host_term_val"]].fill(-1)
+        R = self._R
+        packed[R["host_term_idx"]].fill(-1)
+        packed[R["host_term_val"]].fill(-1)
         consumed: Dict[int, Tuple[Any, Any]] = {}
         hot = self._hot
         self._hot = set()
+        groups = self.groups
+        # the two hot message types are encoded COLUMNWISE after the pop
+        # loop (numpy scalar stores per field per message were a top
+        # cost); everything else goes through the scalar _encode
+        aer_i: List[int] = []
+        aer_m: List[AppendEntriesRpc] = []
+        aer_s: List[int] = []
+        rep_i: List[int] = []
+        rep_m: List[AppendEntriesReply] = []
+        rep_s: List[int] = []
         for i in hot:
-            g = self.groups[i]
+            g = groups[i]
             if g is None:
                 continue
             if g.host_term_hint is not None:
-                packed[self._R["host_term_idx"], i] = g.host_term_hint[0]
-                packed[self._R["host_term_val"], i] = g.host_term_hint[1]
+                packed[R["host_term_idx"], i] = g.host_term_hint[0]
+                packed[R["host_term_val"], i] = g.host_term_hint[1]
                 g.host_term_hint = None
             if not g.inbox:
                 continue
             from_sid, msg = g.inbox.popleft()
             consumed[i] = (from_sid, msg)
-            self._encode(g, from_sid, msg, packed, i)
+            t = type(msg)
+            if t is AppendEntriesRpc:
+                aer_i.append(i)
+                aer_m.append(msg)
+                aer_s.append(g.slot_of(from_sid) if from_sid else 0)
+            elif t is AppendEntriesReply:
+                rep_i.append(i)
+                rep_m.append(msg)
+                rep_s.append(g.slot_of(from_sid) if from_sid else 0)
+            else:
+                self._encode(g, from_sid, msg, packed, i)
             if g.inbox:
                 self._hot.add(i)  # more queued: stay hot for next step
+        if rep_i:
+            ii = np.asarray(rep_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER_REPLY
+            packed[R["sender_slot"], ii] = rep_s
+            packed[R["term"], ii] = [m.term for m in rep_m]
+            packed[R["success"], ii] = [1 if m.success else 0 for m in rep_m]
+            packed[R["reply_next_idx"], ii] = [m.next_index for m in rep_m]
+            packed[R["reply_last_idx"], ii] = [m.last_index for m in rep_m]
+            packed[R["reply_last_term"], ii] = [m.last_term for m in rep_m]
+        if aer_i:
+            ii = np.asarray(aer_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER
+            packed[R["sender_slot"], ii] = aer_s
+            packed[R["term"], ii] = [m.term for m in aer_m]
+            packed[R["prev_idx"], ii] = [m.prev_log_index for m in aer_m]
+            packed[R["prev_term"], ii] = [m.prev_log_term for m in aer_m]
+            packed[R["num_entries"], ii] = [len(m.entries) for m in aer_m]
+            packed[R["entries_last_term"], ii] = [
+                m.entries[-1].term if m.entries else 0 for m in aer_m
+            ]
+            packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
         return jnp.asarray(packed), consumed
 
     def _encode(self, g: GroupHost, from_sid, msg, p, i) -> None:
@@ -804,30 +1001,56 @@ class BatchCoordinator:
 
         groups = self.groups
         needs_host = eg["needs_host"]
-        aer_code = eg["aer_code"]
-        send_reply = eg["send_reply"]
-        term_row = eg["term"]
-        for i, (from_sid, msg) in consumed.items():
-            g = groups[i]
-            if g is None:
-                continue
-            if isinstance(msg, AppendEntriesRpc):
-                if needs_host[i]:
-                    self._host_resolve_aer(g, from_sid, msg, queue_send)
-                elif aer_code[i] == C.AER_OK:
-                    # the host performs the write and owns the durable
-                    # watermark, so it builds the success ack (possibly
-                    # deferred until WAL fsync)
-                    self._host_write_entries(g, msg)
-                    self._ack_aer(g, from_sid, msg, int(term_row[i]), queue_send)
-                elif send_reply[i] and from_sid is not None:
-                    reply = self._build_reply(g, msg, eg, i)
-                    if reply is not None:
-                        queue_send(from_sid, reply, (g.name, self.name))
-            elif send_reply[i] and from_sid is not None:
-                reply = self._build_reply(g, msg, eg, i)
-                if reply is not None:
-                    queue_send(from_sid, reply, (g.name, self.name))
+        # numpy scalar indexing (plus int()/bool() coercion) in a
+        # per-message loop is slow; gather each needed field for exactly
+        # the consumed rows in one vector op, then read python ints
+        if consumed:
+            items = list(consumed.items())
+            ci = np.fromiter((i for i, _ in items), np.int64, len(items))
+            nh_l = needs_host[ci].tolist()
+            code_l = eg["aer_code"][ci].tolist()
+            sr_l = eg["send_reply"][ci].tolist()
+            term_l = eg["term"][ci].tolist()
+            succ_l = eg["success"][ci].tolist()
+            nxt_l = eg["next_index"][ci].tolist()
+            li_l = eg["last_index"][ci].tolist()
+            lt_l = eg["last_term"][ci].tolist()
+            for p, (i, (from_sid, msg)) in enumerate(items):
+                g = groups[i]
+                if g is None:
+                    continue
+                t = type(msg)
+                if t is AppendEntriesRpc:
+                    if nh_l[p]:
+                        self._host_resolve_aer(g, from_sid, msg, queue_send)
+                    elif code_l[p] == C.AER_OK:
+                        # the host performs the write and owns the
+                        # durable watermark, so it builds the success
+                        # ack (possibly deferred until WAL fsync)
+                        self._host_write_entries(g, msg)
+                        self._ack_aer(g, from_sid, msg, term_l[p], queue_send)
+                    elif sr_l[p] and from_sid is not None:
+                        queue_send(
+                            from_sid,
+                            AppendEntriesReply(
+                                term_l[p], bool(succ_l[p]), nxt_l[p],
+                                li_l[p], lt_l[p],
+                            ),
+                            (g.name, self.name),
+                        )
+                elif sr_l[p] and from_sid is not None:
+                    if t is RequestVoteRpc:
+                        queue_send(
+                            from_sid,
+                            RequestVoteResult(term_l[p], bool(succ_l[p])),
+                            (g.name, self.name),
+                        )
+                    elif t is PreVoteRpc:
+                        queue_send(
+                            from_sid,
+                            PreVoteResult(term_l[p], msg.token, bool(succ_l[p])),
+                            (g.name, self.name),
+                        )
 
         # vectorized change detection: only touched groups pay Python cost
         n = self.n_groups
@@ -839,73 +1062,75 @@ class BatchCoordinator:
             | (eg["commit_advanced_to"][:n] > applied)
             | needs_host[:n]
         )
-        role_row = eg["role"]
-        leader_row = eg["leader_slot"]
         touched = (
             interesting.tolist() if len(consumed) == 0
-            else set(consumed) | set(interesting.tolist())
+            else list(set(consumed) | set(interesting.tolist()))
         )
-        for i in touched:
-            g = groups[i]
-            if g is None:
-                continue
-            new_role = int(role_row[i])
-            if (
-                g.pending_queries
-                and g.role == C.R_LEADER
-                and new_role != C.R_LEADER
-            ):
-                # deposed: in-flight linearizable reads must not be
-                # answered from this replica's state
-                for q in g.pending_queries:
-                    self._reply(q["fut"], ("redirect", None))
-                g.pending_queries = []
-            g.role = new_role
-            g.term = int(term_row[i])
-            g.leader_slot = int(leader_row[i])
-            if eg["term_or_vote_changed"][i] and self.meta is not None:
-                # Raft safety: term AND vote must both be durable before
-                # any message leaves this step, or a restarted member
-                # could vote twice in one term
-                uid = f"{g.cluster_name}_{g.name}"
-                self.meta.store(uid, "current_term", g.term)
-                self.meta.store_sync(uid, "voted_for", g.sid_of(int(eg["voted_for"][i])))
-            if eg["became_candidate"][i]:
-                self._hot.add(i)  # keep stepping (single-member self-election)
-                self._broadcast_vote_req(g, queue_send, pre=False)
-            if eg["became_leader"][i]:
-                self._on_became_leader(g, aer_dirty)
-            ci = int(eg["commit_advanced_to"][i])
-            if ci > g.last_applied:
-                self._apply_group(g, ci)
-                aer_dirty.add(i)
-            if eg["needs_host"][i] and g.host_term_hint is None:
-                # quorum term lookup outside the device window (the AER
-                # branch may already have claimed the hint slot; that one
-                # retries first and the quorum resolves next step)
-                agreed = int(eg["agreed_idx"][i])
-                t = g.log.fetch_term(agreed)
-                if t is not None:
-                    g.host_term_hint = (agreed, t)
-                    self._hot.add(i)
+        if touched:
+            ti = np.asarray(touched, np.int64)
+            role_l = eg["role"][ti].tolist()
+            gterm_l = eg["term"][ti].tolist()
+            leader_l = eg["leader_slot"][ti].tolist()
+            tvc_l = eg["term_or_vote_changed"][ti].tolist()
+            voted_l = eg["voted_for"][ti].tolist()
+            bc_l = eg["became_candidate"][ti].tolist()
+            bl_l = eg["became_leader"][ti].tolist()
+            ca_l = eg["commit_advanced_to"][ti].tolist()
+            nh2_l = needs_host[ti].tolist()
+            ag_l = eg["agreed_idx"][ti].tolist()
+            now_roles = time.monotonic()
+            for p, i in enumerate(touched):
+                g = groups[i]
+                if g is None:
+                    continue
+                new_role = role_l[p]
+                if new_role != g.role:
+                    # role transitions restart the leaderless-suspicion
+                    # window (a just-deposed leader must give the new
+                    # one a chance to make contact before suspecting)
+                    g.last_contact = now_roles
+                if (
+                    g.pending_queries
+                    and g.role == C.R_LEADER
+                    and new_role != C.R_LEADER
+                ):
+                    # deposed: in-flight linearizable reads must not be
+                    # answered from this replica's state
+                    for q in g.pending_queries:
+                        self._reply(q["fut"], ("redirect", None))
+                    g.pending_queries = []
+                g.role = new_role
+                g.term = gterm_l[p]
+                g.leader_slot = leader_l[p]
+                if tvc_l[p] and self.meta is not None:
+                    # Raft safety: term AND vote must both be durable
+                    # before any message leaves this step, or a
+                    # restarted member could vote twice in one term
+                    uid = f"{g.cluster_name}_{g.name}"
+                    self.meta.store(uid, "current_term", g.term)
+                    self.meta.store_sync(uid, "voted_for", g.sid_of(voted_l[p]))
+                if bc_l[p]:
+                    self._hot.add(i)  # keep stepping (single-member self-election)
+                    self._broadcast_vote_req(g, queue_send, pre=False)
+                if bl_l[p]:
+                    self._on_became_leader(g, aer_dirty)
+                ci2 = ca_l[p]
+                if ci2 > g.last_applied:
+                    self._apply_group(g, ci2)
+                    aer_dirty.add(i)
+                if nh2_l[p] and g.host_term_hint is None:
+                    # quorum term lookup outside the device window (the
+                    # AER branch may already have claimed the hint slot;
+                    # that one retries first and the quorum resolves
+                    # next step)
+                    agreed = ag_l[p]
+                    t2 = g.log.fetch_term(agreed)
+                    if t2 is not None:
+                        g.host_term_hint = (agreed, t2)
+                        self._hot.add(i)
 
         for node_name, msgs in outbound.items():
             self._send_batch(node_name, msgs)
-
-    def _build_reply(self, g: GroupHost, msg, eg, i):
-        if isinstance(msg, AppendEntriesRpc):
-            return AppendEntriesReply(
-                term=int(eg["term"][i]),
-                success=bool(eg["success"][i]),
-                next_index=int(eg["next_index"][i]),
-                last_index=int(eg["last_index"][i]),
-                last_term=int(eg["last_term"][i]),
-            )
-        if isinstance(msg, RequestVoteRpc):
-            return RequestVoteResult(int(eg["term"][i]), bool(eg["success"][i]))
-        if isinstance(msg, PreVoteRpc):
-            return PreVoteResult(int(eg["term"][i]), msg.token, bool(eg["success"][i]))
-        return None
 
     def _host_resolve_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, queue_send):
         """Deep backfill: resolve the prev term from the host log and
@@ -935,7 +1160,7 @@ class BatchCoordinator:
         li, _ = g.log.last_index_term()
         if msg.entries[0].index == li + 1:
             # fast path (steady-state pipeline): strictly-new suffix
-            to_write = list(msg.entries)
+            to_write = msg.entries
         else:
             to_write = []
             for e in msg.entries:
@@ -947,27 +1172,37 @@ class BatchCoordinator:
                 to_write = [e for e in msg.entries if e.index > li]
         if to_write:
             first_idx = to_write[0].index
-            if first_idx <= li and g.cluster_history:
-                # overwriting a divergent suffix: roll back any cluster
-                # adoption that rode on the truncated entries
-                keep = [h for h in g.cluster_history if h[0] < first_idx]
-                undone = [h for h in g.cluster_history if h[0] >= first_idx]
-                if undone:
-                    _, members, voter = undone[0]
-                    g.members = list(members)
-                    g.voter_status = dict(voter)
-                    g.cluster_history = keep
-                    self._sync_member_rows(g)
-            g.log.write(list(to_write))
+            if first_idx <= li:
+                # overwriting a divergent suffix: truncated specials are
+                # gone, and any cluster adoption that rode on them must
+                # be rolled back
+                if g.specials and g.specials[-1] >= first_idx:
+                    g.specials = [s for s in g.specials if s < first_idx]
+                if g.cluster_history:
+                    keep = [h for h in g.cluster_history if h[0] < first_idx]
+                    undone = [h for h in g.cluster_history if h[0] >= first_idx]
+                    if undone:
+                        _, members, voter = undone[0]
+                        g.members = list(members)
+                        g.voter_status = dict(voter)
+                        g.cluster_history = keep
+                        self._sync_member_rows(g)
+            g.log.write(to_write)
             # followers adopt replicated cluster changes at write time
             # (reference: cluster scan on follower writes,
-            # src/ra_server.erl:1005-1040)
+            # src/ra_server.erl:1005-1040) and index every non-USR
+            # entry for the apply fast path
+            specials = g.specials
             for e in to_write:
                 c = e.cmd
-                if isinstance(c, Command) and c.kind in (
-                    RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE,
-                ):
-                    self._adopt_cluster_cmd(g, c, e.index)
+                if type(c) is not Command:
+                    specials.append(e.index)
+                    continue
+                k = c.kind
+                if k != USR:
+                    specials.append(e.index)
+                    if k in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                        self._adopt_cluster_cmd(g, c, e.index)
             # reconcile the device term ring exactly (clears the
             # multi-entry unknown interval next step); contiguous
             # same-term spans collapse to one run row
@@ -1013,6 +1248,7 @@ class BatchCoordinator:
         # the new term's noop (commit gate + version carrier)
         idx = g.log.next_index()
         g.log.append(Entry(index=idx, term=g.term, cmd=Command(kind=NOOP)))
+        g.specials.append(idx)
         g.noop_index = idx
         g.noop_committed = False
         g.cluster_change_permitted = False
@@ -1042,28 +1278,25 @@ class BatchCoordinator:
         mver = g.effective_machine_version
         state = g.machine_state
         is_leader = g.role == C.R_LEADER
-        if not pending and len(entries) > 1 and all(
-            type(e.cmd) is Command
-            and (e.cmd.kind == USR
-                 or (e.cmd.kind == NOOP and e.cmd.machine_version <= mver))
-            for e in entries
+        specials = g.specials
+        if specials and specials[0] <= g.last_applied:
+            # stale entries (already applied or compacted away)
+            g.specials = specials = [s for s in specials if s > g.last_applied]
+        if (
+            not pending
+            and len(entries) > 1
+            and (not specials or specials[0] > hi)
         ):
-            # plain user-command run with no replies owed: offer the
-            # machine the whole payload batch at once (apply_many hook)
-            cmds = [e.cmd.data for e in entries if e.cmd.kind == USR]
-            if cmds:
-                batched = machine.which_module(mver).apply_many(
-                    {"index": hi, "term": entries[-1].term,
-                     "machine_version": mver},
-                    cmds, state,
-                )
-                if batched is not None:
-                    g.machine_state = batched
-                    g.last_applied = hi
-                    self._applied_np[g.gid] = hi
-                    self._commit_gates(g, hi, is_leader)
-                    return
-            else:
+            # plain user-command run with no replies owed (the specials
+            # index proves it without scanning): offer the machine the
+            # whole payload batch at once (apply_many hook)
+            batched = machine.which_module(mver).apply_many(
+                {"index": hi, "term": entries[-1].term,
+                 "machine_version": mver},
+                [e.cmd.data for e in entries], state,
+            )
+            if batched is not None:
+                g.machine_state = batched
                 g.last_applied = hi
                 self._applied_np[g.gid] = hi
                 self._commit_gates(g, hi, is_leader)
@@ -1277,6 +1510,8 @@ class BatchCoordinator:
             if s != g.self_slot and member is not None:
                 queue_send(member, rpc, sid)
 
+    _NEEDS_SNAPSHOT = object()  # rpc-cache sentinel
+
     def _send_aers(self, aer_dirty) -> None:
         outbound: Dict[str, List] = {}
         for gid in aer_dirty:
@@ -1286,32 +1521,43 @@ class BatchCoordinator:
             li, _ = g.log.last_index_term()
             commit = g.last_applied  # host mirror of commit (applied == committed here)
             sid = (g.name, self.name)
+            # peers at the same next_index (the steady-state pipeline)
+            # share ONE immutable rpc: one entry fetch, one object
+            rpc_cache: Dict[int, Any] = {}
             for s, member in enumerate(g.members):
                 if s == g.self_slot or member is None:
                     continue
                 nxt = g.next_index[s]
-                entries: List[Entry] = []
-                if nxt <= li:
-                    entries = g.log.fetch_range(
-                        nxt, min(li, nxt + self.aer_batch_size - 1)
-                    )
-                elif commit <= g.commit_sent[s]:
+                if nxt > li and commit <= g.commit_sent[s]:
                     continue  # nothing new to say
-                prev_idx = nxt - 1
-                prev_term = g.log.fetch_term(prev_idx)
-                snap = g.log.snapshot_index_term()
-                if prev_term is None or (snap is not None and prev_idx < snap[0]):
+                rpc = rpc_cache.get(nxt)
+                if rpc is None:
+                    entries: List[Entry] = []
+                    if nxt <= li:
+                        entries = g.log.fetch_range(
+                            nxt, min(li, nxt + self.aer_batch_size - 1)
+                        )
+                    prev_idx = nxt - 1
+                    prev_term = g.log.fetch_term(prev_idx)
+                    snap = g.log.snapshot_index_term()
+                    if prev_term is None or (
+                        snap is not None and prev_idx < snap[0]
+                    ):
+                        rpc = self._NEEDS_SNAPSHOT
+                    else:
+                        rpc = AppendEntriesRpc(
+                            term=g.term, leader_id=sid, prev_log_index=prev_idx,
+                            prev_log_term=prev_term, leader_commit=commit,
+                            entries=tuple(entries),
+                        )
+                    rpc_cache[nxt] = rpc
+                if rpc is self._NEEDS_SNAPSHOT:
                     # peer is behind our compacted floor: stream a snapshot
                     self._start_snapshot_sender(g, member)
                     continue
-                rpc = AppendEntriesRpc(
-                    term=g.term, leader_id=sid, prev_log_index=prev_idx,
-                    prev_log_term=prev_term, leader_commit=commit,
-                    entries=tuple(entries),
-                )
                 outbound.setdefault(member[1], []).append((member, rpc, sid))
-                if entries:
-                    g.next_index[s] = entries[-1].index + 1
+                if rpc.entries:
+                    g.next_index[s] = rpc.entries[-1].index + 1
                 g.commit_sent[s] = commit
         for node_name, msgs in outbound.items():
             self._send_batch(node_name, msgs)
@@ -1329,6 +1575,7 @@ class BatchCoordinator:
             self._pending_roles.append((g.gid, C.R_PRE_VOTE))
             g.role = C.R_PRE_VOTE
             g.pre_vote_token += 1
+            g.last_contact = time.monotonic()  # election-retry window restarts
             self._hot.add(g.gid)  # force steps so the election progresses
             if len(g.members) == 1:
                 return  # the next device steps self-elect
@@ -1372,6 +1619,7 @@ class BatchCoordinator:
             # that never acknowledged the term would be meaningless).
             if from_sid is not None:
                 if msg.term >= g.term:
+                    g.last_contact = time.monotonic()
                     if msg.term > g.term or g.role != C.R_FOLLOWER:
                         self._adopt_term(g, msg.term, leader_sid=from_sid)
                     elif g.leader_slot < 0:
@@ -1402,6 +1650,7 @@ class BatchCoordinator:
             idx = g.log.next_index()
             g.log.append(Entry(index=idx, term=g.term, cmd=Command(
                 kind="ra_cluster_change", data=("replace", ((me, "voter"),)))))
+            g.specials.append(idx)
             self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
             g.members = [me]
             g.self_slot = 0
@@ -1570,6 +1819,7 @@ class BatchCoordinator:
         bumped = term > g.term
         g.term = max(g.term, term)
         g.role = C.R_FOLLOWER
+        g.last_contact = time.monotonic()
         g.leader_slot = g.slot_of(leader_sid) if leader_sid is not None else -1
         if bumped and self.meta is not None:
             # entering a new term clears the durable vote (the device
@@ -1625,6 +1875,7 @@ class BatchCoordinator:
             li, lt = g.log.last_index_term()
             send_one(InstallSnapshotResult(g.term, li, lt))
             return
+        g.last_contact = time.monotonic()
         if msg.chunk_phase == CHUNK_INIT:
             # INIT always starts a fresh accumulator — a retried transfer
             # at the same index must not append onto stale chunks
@@ -1661,6 +1912,8 @@ class BatchCoordinator:
         g.effective_machine_version = meta.machine_version
         g.last_applied = max(g.last_applied, meta.index)
         g.snap_floor = max(g.snap_floor, meta.index)
+        if g.specials:
+            g.specials = [s for s in g.specials if s > meta.index]
         # adopt the snapshot's member set (node-local slot coordinates)
         if meta.cluster:
             new = [tuple(m) for m in meta.cluster]
@@ -1776,21 +2029,55 @@ class BatchCoordinator:
                     self._node_status[other] = alive
                     if prev is True and not alive:
                         self._on_node_down(other)
-                # suspicion sweep (transitions can be missed): followers
-                # with a dead leader node retry elections on a cooldown
+                # suspicion sweep. Three leaderless shapes need retry —
+                # without it a partition heal can wedge a group forever
+                # (nobody re-elects once every node is "alive" again):
+                #   1. a stalled election (pre-vote/candidate whose
+                #      messages were lost) — mirror the actor backend's
+                #      state-enter election timer;
+                #   2. a follower with a known leader: dead leader node
+                #      fires immediately; an alive-but-silent one (a
+                #      deposed leader that never re-won) times out on
+                #      lost contact — the resync probe guarantees a live
+                #      leader contacts every peer within ~2 ticks;
+                #   3. a follower with NO known leader (term bumped by a
+                #      failed election) — contact timeout, gated on
+                #      term > 0 so fresh clusters still boot quiet until
+                #      explicitly triggered (reference: ra:start_cluster
+                #      calls trigger_election; no idle heartbeats).
+                # window >> the 2-tick probe cadence: device pre-vote
+                # grants have no leader-stickiness, so a trigger-happy
+                # sweep could dethrone a healthy but loaded leader
                 now = time.monotonic()
+                contact_window = max(
+                    5 * self.tick_interval_s, 6 * self.election_timeout_s
+                )
                 for i in range(self.n_groups):
                     g = self.groups[i]
                     if g is None or g.role == C.R_LEADER:
                         continue
+                    if g.voter_status.get(g.self_slot) != "voter":
+                        continue
                     leader = g.sid_of(g.leader_slot)
-                    if (
-                        leader is not None
-                        and leader[1] != self.name
-                        and not self.transport.node_alive(leader[1])
-                        and now - cooldown.get(i, 0.0) > 3 * self.election_timeout_s
-                    ):
-                        cooldown[i] = now + random.random() * self.election_timeout_s
+                    if g.role in (C.R_PRE_VOTE, C.R_CANDIDATE):
+                        suspicious = (
+                            now - g.last_contact > 2 * self.election_timeout_s
+                        )
+                    elif leader is not None and leader[1] != self.name:
+                        suspicious = (
+                            not self.transport.node_alive(leader[1])
+                            or now - g.last_contact > contact_window
+                        )
+                    else:
+                        suspicious = (
+                            g.term > 0
+                            and now - g.last_contact > contact_window
+                        )
+                    if suspicious and now >= cooldown.get(i, 0.0):
+                        cooldown[i] = (
+                            now + 2 * self.election_timeout_s
+                            + random.random() * 2 * self.election_timeout_s
+                        )
                         self.deliver((g.name, self.name), ElectionTimeout(), None)
             except Exception:  # noqa: BLE001
                 pass
