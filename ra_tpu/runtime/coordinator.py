@@ -220,6 +220,7 @@ class BatchCoordinator:
         idle_sleep_s: float = 0.0005,
         tick_interval_s: float = 1.0,
         send_msg_cb=None,
+        mesh=None,
     ):
         self.name = node_name
         self.capacity = capacity
@@ -237,6 +238,26 @@ class BatchCoordinator:
             active=jnp.zeros((capacity, num_peers), dtype=jnp.bool_),
             voting=jnp.zeros((capacity, num_peers), dtype=jnp.bool_),
         )
+        # multi-chip: shard the GROUP axis of all consensus state over
+        # the mesh (replica axis P rides along unsharded). Every group's
+        # decision math is independent, so the fused step partitions
+        # with zero cross-device communication; host scatters address
+        # groups by id and GSPMD routes them. The state is re-pinned to
+        # the sharding before each fused step (host-side single-row
+        # updates may produce replicated layouts).
+        self._shard_state = self._shard_mbox = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            n_dev = mesh.devices.size
+            if capacity % n_dev:
+                raise ValueError(
+                    f"capacity {capacity} not divisible by mesh size {n_dev}"
+                )
+            axis = mesh.axis_names[0]
+            self._shard_state = NamedSharding(mesh, PartitionSpec(axis))
+            self._shard_mbox = NamedSharding(mesh, PartitionSpec(None, axis))
+            self.state = jax.device_put(self.state, self._shard_state)
         self.groups: List[Optional[GroupHost]] = [None] * capacity
         self.by_name: Dict[str, GroupHost] = {}
         self.n_groups = 0
@@ -326,7 +347,10 @@ class BatchCoordinator:
                 name = to[0]
                 if type(m) is Command:
                     # inlined _enqueue_cmd normal path (hot: one call
-                    # per pipelined command)
+                    # per pipelined command); unknown names drop here
+                    # too, matching deliver()
+                    if name not in by:
+                        continue
                     if m.priority == "low":
                         self._enqueue_cmd(name, None, m)
                         continue
@@ -481,6 +505,11 @@ class BatchCoordinator:
             cmd_q = self._cmd_q
             if cmd_q:
                 self._cmd_q = {}
+            else:
+                # never keep an alias of the LIVE (empty) dict — a
+                # concurrent deliver would fill it and the next drain
+                # would double-process those commands
+                cmd_q = None
         rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = []
         # appended runs: gid -> [[lo, hi, term], ...] (contiguous,
         # same-term); written: gid -> max durable idx. Run-based so the
@@ -497,10 +526,11 @@ class BatchCoordinator:
                 continue
             route(g, from_sid, msg, rare, appended, written, aer_dirty)
         # commands were pre-grouped per target at delivery time
-        for name, cmds in cmd_q.items():
-            g = by_get(name)
-            if g is not None:
-                self._handle_commands(g, cmds, appended, written, aer_dirty)
+        if cmd_q:
+            for name, cmds in cmd_q.items():
+                g = by_get(name)
+                if g is not None:
+                    self._handle_commands(g, cmds, appended, written, aer_dirty)
         if self._low_dirty:
             self._drain_low_lane(appended, written, aer_dirty)
 
@@ -551,6 +581,11 @@ class BatchCoordinator:
             self.state = C.record_written(self.state, gids, idxs)
 
         packed, consumed = self._build_mailbox()
+        if self._shard_state is not None:
+            # re-pin before the fused step so it executes SPMD over the
+            # mesh (no-op when the layout is already right)
+            self.state = jax.device_put(self.state, self._shard_state)
+            packed = jax.device_put(packed, self._shard_mbox)
         self.state, eg_packed = C.consensus_step_packed(self.state, packed)
         eg_np = np.asarray(eg_packed)
         eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
@@ -670,22 +705,34 @@ class BatchCoordinator:
         still: set = set()
         for gid in dirty:
             g = self.groups[gid]
-            if g is None or not g.low_q:
+            if g is None:
                 continue
-            if g.role != C.R_LEADER:
+            # pop under the ingress lock — delivery threads append to
+            # low_q under it; replies/appends happen outside
+            with self._ingress_cv:
+                if not g.low_q:
+                    continue
+                if g.role != C.R_LEADER:
+                    drained = list(g.low_q)
+                    g.low_q.clear()
+                    take = None
+                else:
+                    drained = None
+                    take = [
+                        g.low_q.popleft()
+                        for _ in range(
+                            min(self.FLUSH_COMMANDS_SIZE, len(g.low_q))
+                        )
+                    ]
+                    if g.low_q:
+                        still.add(gid)
+            if drained is not None:
                 red = ("redirect", g.sid_of(g.leader_slot))
-                for cmd in g.low_q:
+                for cmd in drained:
                     if cmd.from_ref is not None:
                         self._reply(cmd.from_ref, red)
-                g.low_q.clear()
-                continue
-            take = [
-                g.low_q.popleft()
-                for _ in range(min(self.FLUSH_COMMANDS_SIZE, len(g.low_q)))
-            ]
-            self._handle_commands(g, take, appended, written, aer_dirty)
-            if g.low_q:
-                still.add(gid)
+            else:
+                self._handle_commands(g, take, appended, written, aer_dirty)
         if still:
             with self._ingress_cv:
                 self._low_dirty |= still
