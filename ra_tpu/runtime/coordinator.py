@@ -254,9 +254,12 @@ class BatchCoordinator:
                 raise ValueError(
                     f"capacity {capacity} not divisible by mesh size {n_dev}"
                 )
-            axis = mesh.axis_names[0]
-            self._shard_state = NamedSharding(mesh, PartitionSpec(axis))
-            self._shard_mbox = NamedSharding(mesh, PartitionSpec(None, axis))
+            # the group axis shards over EVERY mesh axis — a 2-D mesh
+            # (e.g. ici x dcn) still engages all devices instead of
+            # silently replicating over the unnamed axes
+            axes = tuple(mesh.axis_names)
+            self._shard_state = NamedSharding(mesh, PartitionSpec(axes))
+            self._shard_mbox = NamedSharding(mesh, PartitionSpec(None, axes))
             self.state = jax.device_put(self.state, self._shard_state)
         self.groups: List[Optional[GroupHost]] = [None] * capacity
         self.by_name: Dict[str, GroupHost] = {}
