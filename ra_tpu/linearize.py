@@ -87,18 +87,32 @@ def check_register(
     for i, o in enumerate(ops):
         if not o.indeterminate:
             determinate_mask |= 1 << i
-    seen: set = set()
-    # iterative DFS carrying the chosen order for the witness
-    stack: List[Tuple[int, Any, Tuple[int, ...]]] = [(0, init, ())]
+    # iterative DFS; the memo maps (mask, state) -> (parent_key, op_i)
+    # so each stack entry is O(1) and the witness is reconstructed by
+    # walking predecessors (carrying the order tuple per entry would
+    # allocate O(n) per state and defeat the max_states budget)
+    parent: Dict[Tuple[int, Any], Tuple[Optional[Tuple[int, Any]], int]] = {}
+    stack: List[Tuple[int, Any, Optional[Tuple[int, Any]], int]] = [
+        (0, init, None, -1)
+    ]
     while stack:
-        if len(seen) > max_states:
+        if len(parent) > max_states:
             raise TooManyStates(f"exceeded {max_states} search states")
-        mask, state, order = stack.pop()
-        if (mask, state) in seen:
+        mask, state, pkey, op_i = stack.pop()
+        key = (mask, state)
+        if key in parent:
             continue
-        seen.add((mask, state))
+        parent[key] = (pkey, op_i)
         if mask & determinate_mask == determinate_mask:
-            return list(order)
+            out: List[int] = []
+            k: Optional[Tuple[int, Any]] = key
+            while k is not None:
+                pk, oi = parent[k]
+                if oi >= 0:
+                    out.append(oi)
+                k = pk
+            out.reverse()
+            return out
         # two smallest return times among un-linearized ops, so the
         # real-time constraint (j returned before i invoked => j first)
         # can exclude each candidate's own ret
@@ -125,7 +139,7 @@ def check_register(
                 nxt = state
             else:
                 nxt = o.value
-            stack.append((mask | (1 << i), nxt, order + (i,)))
+            stack.append((mask | (1 << i), nxt, key, i))
     return None
 
 
@@ -189,7 +203,6 @@ def _client_loop(
     n_ops: int,
     do_write,
     do_read,
-    op_timeout: float,
 ) -> None:
     rng = random.Random(seed * 1000 + cid)
     seq = 0
@@ -245,7 +258,7 @@ def run_workload(
             threading.Thread(
                 target=_client_loop,
                 args=(recorder, cid, seed, keys, ops_per_client,
-                      do_write, do_read, op_timeout),
+                      do_write, do_read),
                 daemon=True,
             )
             for cid in range(n_clients)
@@ -268,6 +281,55 @@ def run_workload(
 # -- backend wiring ---------------------------------------------------------
 
 
+def _make_ops(ids, op_timeout: float, seed: int):
+    """The client closures are backend-independent: both backends serve
+    the same public API surface."""
+    from ra_tpu import api
+
+    pick = random.Random(seed ^ 0xC11E)
+
+    def do_write(key, value):
+        cmd = ("put", key, value) if value is not None else ("delete", key)
+        api.process_command(pick.choice(ids), cmd, timeout=op_timeout)
+
+    def do_read(key):
+        out = api.consistent_query(
+            pick.choice(ids), lambda s, k=key: s.get(k), timeout=op_timeout
+        )
+        return out[1]
+
+    return do_write, do_read
+
+
+def _make_nemesis(names, get_transport):
+    """Partition nemesis over a ``name -> transport`` accessor (the only
+    thing that differs between backends)."""
+    blocked = [None]
+
+    def nemesis_step(rng):
+        if blocked[0] is None and rng.random() < 0.7:
+            victim = rng.choice(names)
+            for n in names:
+                if n != victim:
+                    tv, tn = get_transport(victim), get_transport(n)
+                    if tv is not None:
+                        tv.block(victim, n)
+                    if tn is not None:
+                        tn.block(n, victim)
+            blocked[0] = victim
+        else:
+            heal()
+
+    def heal():
+        for n in names:
+            t = get_transport(n)
+            if t is not None:
+                t.unblock_all()
+        blocked[0] = None
+
+    return nemesis_step, heal
+
+
 def _setup_actor(seed: int, nodes: int, op_timeout: float):
     import tempfile
 
@@ -286,41 +348,13 @@ def _setup_actor(seed: int, nodes: int, op_timeout: float):
         )
     ids = [(f"lk{i}", names[i]) for i in range(nodes)]
     api.start_cluster(f"linc{seed}", DictKv, ids, timeout=20)
-    pick = random.Random(seed ^ 0xC11E)
+    do_write, do_read = _make_ops(ids, op_timeout, seed)
 
-    def do_write(key, value):
-        cmd = ("put", key, value) if value is not None else ("delete", key)
-        api.process_command(pick.choice(ids), cmd, timeout=op_timeout)
+    def get_transport(n):
+        node = node_registry().get(n)
+        return None if node is None else node.transport
 
-    def do_read(key):
-        out = api.consistent_query(
-            pick.choice(ids), lambda s, k=key: s.get(k), timeout=op_timeout
-        )
-        return out[1]
-
-    blocked = [None]
-
-    def nemesis_step(rng):
-        if blocked[0] is None and rng.random() < 0.7:
-            victim = rng.choice(names)
-            for n in names:
-                if n != victim:
-                    a = node_registry().get(victim)
-                    b = node_registry().get(n)
-                    if a is not None:
-                        a.transport.block(victim, n)
-                    if b is not None:
-                        b.transport.block(n, victim)
-            blocked[0] = victim
-        else:
-            heal()
-
-    def heal():
-        for n in names:
-            node = node_registry().get(n)
-            if node is not None:
-                node.transport.unblock_all()
-        blocked[0] = None
+    nemesis_step, heal = _make_nemesis(names, get_transport)
 
     def teardown():
         heal()
@@ -335,7 +369,7 @@ def _setup_actor(seed: int, nodes: int, op_timeout: float):
 
 
 def _setup_batch(seed: int, nodes: int, op_timeout: float):
-    from ra_tpu import api, leaderboard
+    from ra_tpu import leaderboard
     from ra_tpu.kv_harness import DictKv
     from ra_tpu.protocol import ElectionTimeout
     from ra_tpu.runtime.coordinator import BatchCoordinator
@@ -360,35 +394,10 @@ def _setup_batch(seed: int, nodes: int, op_timeout: float):
         coords[n].by_name[gname].role == C.R_LEADER for n in names
     ):
         time.sleep(0.05)
-    pick = random.Random(seed ^ 0xC11E)
-
-    def do_write(key, value):
-        cmd = ("put", key, value) if value is not None else ("delete", key)
-        api.process_command(pick.choice(ids), cmd, timeout=op_timeout)
-
-    def do_read(key):
-        out = api.consistent_query(
-            pick.choice(ids), lambda s, k=key: s.get(k), timeout=op_timeout
-        )
-        return out[1]
-
-    blocked = [None]
-
-    def nemesis_step(rng):
-        if blocked[0] is None and rng.random() < 0.7:
-            victim = rng.choice(names)
-            for n in names:
-                if n != victim:
-                    coords[victim].transport.block(victim, n)
-                    coords[n].transport.block(n, victim)
-            blocked[0] = victim
-        else:
-            heal()
-
-    def heal():
-        for c in coords.values():
-            c.transport.unblock_all()
-        blocked[0] = None
+    do_write, do_read = _make_ops(ids, op_timeout, seed)
+    nemesis_step, heal = _make_nemesis(
+        names, lambda n: coords[n].transport
+    )
 
     def teardown():
         heal()

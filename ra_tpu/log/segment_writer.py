@@ -55,6 +55,7 @@ class SegmentWriter:
         self._open: Dict[str, SegmentWriterHandle] = {}
         self._cv = threading.Condition()
         self._queue: deque = deque()
+        self._inflight = None  # job popped but not finished (crash safety)
         self._closed = False
         self._idle = threading.Event()
         self._idle.set()
@@ -86,6 +87,27 @@ class SegmentWriter:
 
     def wait_idle(self, timeout: float = 10.0) -> bool:
         return self._idle.wait(timeout)
+
+    def thread_alive(self) -> bool:
+        """Flusher-thread liveness for the node's infra supervisor
+        (non-threaded mode drains synchronously: always 'alive')."""
+        return self._thread is None or self._thread.is_alive()
+
+    def revive_thread(self) -> None:
+        """Restart a dead flusher thread (supervision). The job queue
+        survives, and a job that was IN FLIGHT when the thread died is
+        requeued at the front (its seqs dict already dropped finished
+        uids, so completed flushes are not replayed)."""
+        with self._cv:
+            if self._closed or self._thread is None or self._thread.is_alive():
+                return
+            if self._inflight is not None:
+                self._queue.appendleft(self._inflight)
+                self._inflight = None
+            self._thread = threading.Thread(
+                target=self._run, name="ra-segment-writer", daemon=True
+            )
+            self._thread.start()
 
     def my_segments(self, uid: str) -> List[str]:
         d = self._server_dir(uid)
@@ -123,7 +145,9 @@ class SegmentWriter:
                 if not self._queue:
                     self._idle.set()
                     return
-                seqs, wal_file, attempt = self._queue.popleft()
+                job = self._queue.popleft()
+                self._inflight = job
+            seqs, wal_file, attempt = job
             try:
                 self._flush_job(seqs)
             except Exception as exc:  # noqa: BLE001
@@ -134,18 +158,21 @@ class SegmentWriter:
                 # flush order is preserved); after that, leave the WAL
                 # file on disk so boot-time recovery can replay it.
                 self.counter.incr("flush_errors")
-                if attempt + 1 < self.MAX_FLUSH_ATTEMPTS:
-                    with self._cv:
+                with self._cv:
+                    self._inflight = None
+                    if attempt + 1 < self.MAX_FLUSH_ATTEMPTS:
                         self._queue.appendleft((seqs, wal_file, attempt + 1))
                         # interruptible backoff (close() notifies); total
                         # worst-case stall per job is < 1s
                         self._cv.wait(timeout=min(0.05 * (2 ** attempt), 0.4))
-                else:
-                    logger.error(
-                        "segment_writer: flush failed after %d attempts, "
-                        "retaining %r: %r", attempt + 1, wal_file, exc,
-                    )
+                    else:
+                        logger.error(
+                            "segment_writer: flush failed after %d attempts, "
+                            "retaining %r: %r", attempt + 1, wal_file, exc,
+                        )
                 continue
+            with self._cv:
+                self._inflight = None
             if wal_file and os.path.exists(wal_file):
                 os.unlink(wal_file)
 

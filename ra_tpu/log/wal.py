@@ -186,7 +186,16 @@ class Wal:
                     return
                 batch = self._take_batch_locked()
             if batch:
-                self._write_batch(batch)
+                try:
+                    self._write_batch(batch)
+                except Exception as exc:  # noqa: BLE001
+                    # any unexpected error is a failure episode, same as
+                    # a file I/O error: the batch is unacked (servers
+                    # resend after reopen) and the writer thread LIVES —
+                    # a silently dead WAL thread would wedge every
+                    # server on the node. BaseExceptions still kill the
+                    # thread; the node's infra supervisor revives it.
+                    self._fail(exc)
 
     def _take_batch_locked(self) -> List[Tuple]:
         batch = []
@@ -381,13 +390,30 @@ class Wal:
     def failed(self) -> bool:
         return self._failed
 
+    def thread_alive(self) -> bool:
+        """Writer-thread liveness for the node's infra supervisor
+        (non-threaded mode drains synchronously: always 'alive')."""
+        return self._thread is None or self._thread.is_alive()
+
+    def revive_thread(self) -> None:
+        """Restart a dead writer thread (supervision; the queue and
+        file state survive — un-drained writes flush on the new
+        thread)."""
+        if self._closed or self._thread is None or self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._run, name="ra-wal", daemon=True)
+        self._thread.start()
+
     def reopen(self) -> bool:
         """Roll to a fresh file after a failure (the supervisor-restart
         analog). The failed file stays on disk — acked batches in it are
         durable and boot recovery re-reads it. Per-writer gap state is
-        reset so servers' resent tails are accepted in-seq."""
+        reset so servers' resent tails are accepted in-seq. Also revives
+        a dead writer thread, so one code path heals both failure
+        shapes (I/O error, thread death)."""
         with self._cv:
             if not self._failed:
+                self.revive_thread()
                 return True  # another reopen already succeeded
             with self._io_lock:
                 try:
@@ -400,9 +426,10 @@ class Wal:
                     self._open_next()
                     self._last_idx = {}
                     self._failed = False
-                    return True
                 except OSError:
                     return False
+        self.revive_thread()
+        return True
 
     def _recover(self) -> None:
         """Re-read surviving WAL files into memtables and hand them to the

@@ -369,10 +369,15 @@ class RaNode:
                 )
 
     def _on_wal_failure(self, exc: BaseException) -> None:
-        """The shared WAL hit an I/O error: put every server into
-        await_condition, then restart the WAL on a fresh file with
-        backoff (the supervision analog; on success servers get wal_up
-        and resend their unwritten tails)."""
+        """The shared WAL failed (I/O error or dead writer thread): put
+        every server into await_condition, then restart the WAL on a
+        fresh file with backoff (the supervision analog; on success
+        servers get wal_up and resend their unwritten tails)."""
+        # NO dedup guard here: every failure episode must get a healer
+        # (Wal._fail one-shots per episode; the supervisor only fires on
+        # a dead thread while not failed). A duplicate cycle costs a
+        # redundant wal_down/wal_up round, which servers tolerate; a
+        # DROPPED episode would wedge the node forever.
         for proc in list(self.procs.values()):
             proc.enqueue(LogEvent(("wal_down",)))
 
@@ -545,11 +550,28 @@ class RaNode:
     # ------------------------------------------------------------------
     # failure detection (reference: aten poll-based node suspicion)
 
+    def _supervise_log_infra(self) -> None:
+        """one_for_all-style supervision of the shared log infra
+        (reference: ra_system_sup / ra_log_sup restart the WAL and
+        segment writer as a unit, src/ra_system_sup.erl:26-40,
+        src/ra_log_sup.erl:20-63). Dependency order: the segment writer
+        is revived FIRST — the WAL hands rollover flushes to it — then a
+        dead WAL thread goes through the same wal_down -> reopen ->
+        wal_up healing cycle as an I/O failure, with no operator
+        action."""
+        if not self.sw.thread_alive():
+            logger.error("supervision: segment-writer thread died; reviving")
+            self.sw.revive_thread()
+        if not self.wal.thread_alive() and not self.wal.failed:
+            logger.error("supervision: wal thread died; restarting log infra")
+            self._on_wal_failure(RuntimeError("wal writer thread died"))
+
     def _detect_loop(self) -> None:
         import time as _t
 
         while self.running:
             try:
+                self._supervise_log_infra()
                 for other in self.transport.known_nodes():
                     if other == self.name:
                         continue

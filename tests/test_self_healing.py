@@ -234,3 +234,155 @@ def test_sparse_records_survive_recovery_without_truncation(tmp_path):
     assert any(e[0] == "written" for e in events), events
     assert not any(e[0] == "resend_write" for e in events), events
     wal2.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised restart of log infra (VERDICT r2 item 6; reference:
+# one_for_all ra_system_sup / ra_log_sup, src/ra_system_sup.erl:26-40,
+# src/ra_log_sup.erl:20-63; WAL/segment-writer crash injection on live
+# clusters, test/coordination_SUITE.erl:31-61)
+
+
+def _kill_wal_thread(node):
+    """Kill the WAL writer THREAD itself (a BaseException escapes the
+    per-batch failure handler) — one-shot: the class impl is restored
+    for the revived thread."""
+
+    def boom(batch):
+        del node.wal.__dict__["_write_batch"]
+        raise SystemExit("injected wal thread death")
+
+    node.wal._write_batch = boom
+
+
+def _kill_segwriter_thread(node):
+    def boom():
+        del node.sw.__dict__["_drain"]
+        raise SystemExit("injected segment-writer thread death")
+
+    node.sw._drain = boom
+
+
+def test_wal_thread_death_self_heals_without_operator(cluster):
+    ids, names = cluster
+    r, leader = api.process_command(ids[0], 1, timeout=15)
+    lnode = registry().get(leader[1])
+    _kill_wal_thread(lnode)
+    # traffic drives the kill; the node's own supervisor must notice the
+    # dead thread and run the wal_down -> reopen -> wal_up cycle with NO
+    # operator action (no _heal_wal call anywhere in this test)
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        try:
+            api.process_command(ids[0], 1, timeout=3, retry_on_timeout=True)
+        except Exception:
+            pass
+        if (
+            "_write_batch" not in lnode.wal.__dict__
+            and lnode.wal.thread_alive()
+            and not lnode.wal.failed
+        ):
+            break
+    # the injection actually fired (boom deletes itself when it raises)
+    assert "_write_batch" not in lnode.wal.__dict__, "kill never fired"
+    await_(lambda: lnode.wal.thread_alive() and not lnode.wal.failed,
+           timeout=20, what="wal thread revived by supervisor")
+    # commits flow across the whole cluster again
+    r, _ = api.process_command(ids[0], 1, timeout=20, retry_on_timeout=True)
+    deadline = time.monotonic() + 20
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        vals = []
+        for sid in ids:
+            try:
+                vals.append(api.local_query(sid, lambda s: s)[1])
+            except Exception:
+                vals.append(None)
+        ok = len(set(vals)) == 1 and vals[0] is not None
+        time.sleep(0.05)
+    assert ok, vals
+
+
+def test_log_infra_kill_loop_sustains_traffic(cluster):
+    """The coordination-suite crash-injection shape: repeated WAL thread
+    kills on rotating nodes mid-traffic; the cluster must sustain
+    commits across every kill with zero manual healing."""
+    ids, names = cluster
+    api.process_command(ids[0], 1, timeout=15)
+    for rnd in range(3):
+        victim = registry().get(names[rnd % 3])
+        _kill_wal_thread(victim)
+        committed = 0
+        deadline = time.monotonic() + 40
+        while committed < 4 and time.monotonic() < deadline:
+            try:
+                api.process_command(ids[(rnd + 1) % 3], 1, timeout=3,
+                                    retry_on_timeout=True)
+                committed += 1
+            except Exception:
+                pass
+        assert committed >= 4, f"round {rnd}: traffic stalled after kill"
+        assert "_write_batch" not in victim.wal.__dict__, (
+            f"round {rnd}: kill never fired"
+        )
+        await_(lambda: victim.wal.thread_alive() and not victim.wal.failed,
+               timeout=30, what=f"round {rnd} wal revived")
+    # every replica converges on one value — nothing was healed by hand
+    deadline = time.monotonic() + 30
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        vals = []
+        for sid in ids:
+            try:
+                vals.append(api.local_query(sid, lambda s: s)[1])
+            except Exception:
+                vals.append(None)
+        ok = len(set(vals)) == 1 and vals[0] is not None
+        time.sleep(0.05)
+    assert ok, vals
+
+
+def test_segment_writer_death_under_load_self_heals(tmp_path):
+    """Kill the segment-writer thread while rollovers are pumping flush
+    jobs at it; the supervisor revives it (queue intact — retained WAL
+    files flush on the new thread) and the cluster keeps committing."""
+    leaderboard.clear()
+    names = ["swk0", "swk1", "swk2"]
+    for n in names:
+        api.start_node(
+            n, SystemConfig(name="swk", data_dir=str(tmp_path / n),
+                            wal_max_size_bytes=2048),
+            election_timeout_s=0.15, tick_interval_s=0.1,
+            detector_poll_s=0.05,
+        )
+    ids = [(f"w{i}", names[i]) for i in range(3)]
+    try:
+        started, failed = api.start_cluster(
+            "swkc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids,
+            timeout=20,
+        )
+        assert failed == []
+        r, leader = api.process_command(ids[0], 1, timeout=15)
+        lnode = registry().get(leader[1])
+        _kill_segwriter_thread(lnode)
+        # 2 KB WAL files roll over constantly under this load, feeding
+        # flush jobs into the (about to die) segment writer
+        for _ in range(40):
+            api.process_command(leader, 1, timeout=15, retry_on_timeout=True)
+        # rollovers really fed the writer and the kill really fired
+        assert "_drain" not in lnode.sw.__dict__, "segwriter kill never fired"
+        await_(lambda: lnode.sw.thread_alive(), timeout=30,
+               what="segment writer revived by supervisor")
+        # it is actually flushing again (drains to idle), and commits
+        # still flow
+        await_(lambda: lnode.sw.wait_idle(0.2), timeout=30,
+               what="segment writer drains")
+        api.process_command(ids[1], 1, timeout=15, retry_on_timeout=True)
+        assert lnode.sw.counter.to_dict()["mem_tables_flushed"] > 0
+    finally:
+        for n in names:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
