@@ -153,6 +153,14 @@ class StateEnter(Effect):
 
 
 @dataclasses.dataclass(frozen=True)
+class StopServer(Effect):
+    """The server asked to be terminated (its own removal committed —
+    reference: handle_leader returning {stop,...}). The runtime stops
+    the proc; the resulting proc-down signal is what lets the remaining
+    members arm elections."""
+
+
+@dataclasses.dataclass(frozen=True)
 class GarbageCollection(Effect):
     pass
 

@@ -398,7 +398,12 @@ class Wal:
     def revive_thread(self) -> None:
         """Restart a dead writer thread (supervision; the queue and
         file state survive — un-drained writes flush on the new
-        thread)."""
+        thread). Synchronized: concurrent healers must never start two
+        writer threads (batch bookkeeping has no writer-side lock)."""
+        with self._cv:
+            self._revive_thread_locked()
+
+    def _revive_thread_locked(self) -> None:
         if self._closed or self._thread is None or self._thread.is_alive():
             return
         self._thread = threading.Thread(target=self._run, name="ra-wal", daemon=True)
@@ -413,7 +418,7 @@ class Wal:
         shapes (I/O error, thread death)."""
         with self._cv:
             if not self._failed:
-                self.revive_thread()
+                self._revive_thread_locked()
                 return True  # another reopen already succeeded
             with self._io_lock:
                 try:
@@ -428,7 +433,7 @@ class Wal:
                     self._failed = False
                 except OSError:
                     return False
-        self.revive_thread()
+            self._revive_thread_locked()
         return True
 
     def _recover(self) -> None:

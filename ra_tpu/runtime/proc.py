@@ -227,6 +227,12 @@ class ServerProc:
     def enqueue(self, msg: Any, front: bool = False) -> None:
         self.actor.send(msg, front=front)
 
+    def _stop_self(self) -> None:
+        try:
+            self.node.stop_server(self.name)
+        except Exception:  # noqa: BLE001 — already stopped is fine
+            pass
+
     def kill(self) -> None:
         self.running = False
         self.timers.cancel(self._tick_ref)
@@ -396,6 +402,14 @@ class ServerProc:
                 self._start_snapshot_sender(eff.to)
             elif isinstance(eff, fx.StateEnter):
                 self._on_state_enter(eff.role)
+            elif isinstance(eff, fx.StopServer):
+                # the server's own removal committed: terminate off the
+                # actor thread (stop_server joins this actor); the
+                # proc-down broadcast lets the rest of the cluster elect
+                threading.Thread(
+                    target=self._stop_self, name=f"ra-stop-{self.name}",
+                    daemon=True,
+                ).start()
             elif isinstance(eff, fx.Timer):
                 self._machine_timer(eff)
             elif isinstance(eff, fx.ModCall):

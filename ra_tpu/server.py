@@ -46,6 +46,7 @@ from ra_tpu.effects import (
     SendSnapshot,
     SendVoteRequests,
     StateEnter,
+    StopServer as StopEffect,
     Timer,
 )
 from ra_tpu.log.api import LogApi
@@ -237,9 +238,12 @@ class Server:
         self.cluster = cluster
         self.cluster_index_term = (idx, term)
         if self.id not in self.cluster:
-            # we may have been removed; keep a self entry for bookkeeping
+            # we may have been removed; keep a self entry for
+            # bookkeeping — as a NON-voter, so quorum math reflects the
+            # new config (a removed leader must not count itself) and a
+            # removed member never stands for election
             self.cluster = dict(cluster)
-            self.cluster[self.id] = PeerState()
+            self.cluster[self.id] = PeerState(voter_status=None)
 
     def members(self) -> List[ServerId]:
         return list(self.cluster.keys())
@@ -489,6 +493,14 @@ class Server:
                 self._pipeline(effects)
             return effects
         if isinstance(msg, RequestVoteRpc):
+            if msg.candidate_id not in self.cluster:
+                # a removed (or never-known) member's stale election must
+                # not depose a working leader (reference:
+                # leader_does_not_abdicate_to_unknown_peer)
+                effects.append(
+                    SendRpc(from_peer, RequestVoteResult(self.current_term, False))
+                )
+                return effects
             if msg.term > self.current_term:
                 self._update_term(msg.term)
                 self._become_follower(effects)
@@ -930,6 +942,19 @@ class Server:
         elif cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
             if not discard and is_leader:
                 self._reply_applied(entry, cmd, None, effects, notify)
+                ps = self.cluster.get(self.id)
+                if (
+                    self.role == LEADER
+                    and ps is not None
+                    and ps.voter_status is None
+                ):
+                    # our own removal committed: relinquish leadership
+                    # AND stop — the proc-down broadcast is what tells
+                    # the remaining members to elect (reference:
+                    # leader_is_removed returns {stop,...},
+                    # test/ra_server_SUITE.erl:2121-2142)
+                    self._become_follower(effects)
+                    effects.append(StopEffect())
 
     def _realise_log_effects(self, entry: Entry, mac_effects: List[Effect]) -> List[Effect]:
         """Machines steer snapshotting via release_cursor / checkpoint
@@ -1212,6 +1237,13 @@ class Server:
             effects.append(
                 SendRpc(from_peer, InstallSnapshotResult(self.current_term, li, lt))
             )
+            return effects
+        if msg.meta.machine_version > self.machine.version():
+            # this member cannot interpret state from a machine version
+            # it does not have: ignore the transfer until the operator
+            # upgrades the module (reference:
+            # follower_ignores_installs_snapshot_with_higher_machine_version,
+            # test/ra_server_SUITE.erl)
             return effects
         self._update_term(msg.term)
         self.leader_id = msg.leader_id
