@@ -35,7 +35,11 @@ def decode_snapshot_chunks(chunks) -> Any:
     pickled byte chunks. Single wire-format rule for both backends."""
     if len(chunks) == 1 and not isinstance(chunks[0], (bytes, bytearray)):
         return chunks[0]
-    return pickle.loads(b"".join(chunks))
+    # chunk bodies arrive over snapshot TRANSFER (untrusted bytes from a
+    # peer): resolve through the wire allowlist, never plain pickle
+    from ra_tpu.utils.wire import wire_loads
+
+    return wire_loads(b"".join(chunks))
 
 
 class SnapshotCodec:
@@ -235,7 +239,7 @@ class SnapshotStore:
         return chunks()
 
     def accept_chunks(self, meta: SnapshotMeta, chunks: List[bytes]) -> Any:
-        state = pickle.loads(b"".join(chunks))
+        state = decode_snapshot_chunks(chunks)  # untrusted transfer bytes
         self.write(meta, state, kind=SNAPSHOT)
         return state
 

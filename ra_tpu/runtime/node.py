@@ -572,7 +572,10 @@ class RaNode:
         while self.running:
             try:
                 self._supervise_log_infra()
-                for other in self.transport.known_nodes():
+                # include previously-seen names: a stopped node
+                # unregisters, and its disappearance must read as death
+                known = set(self.transport.known_nodes()) | set(self._node_status)
+                for other in known:
                     if other == self.name:
                         continue
                     # over TCP, node_alive consults the phi-accrual
@@ -589,24 +592,41 @@ class RaNode:
                         for proc in list(self.procs.values()):
                             proc.on_node_event(other, status)
                 # suspicion sweep: transitions can be missed (a leader
-                # that dies before its node was ever recorded alive) —
-                # a follower with a dead leader node and stale contact
-                # arms its election timer regardless
+                # that dies before its node was ever recorded alive).
+                # Three leaderless shapes arm an election timer (the
+                # same shapes the batch coordinator retries — a live
+                # leader's tick sends an empty commit-sync AER to every
+                # peer, so "no contact for several ticks" is a reliable
+                # leaderless signal here too):
+                #   - known leader on a DEAD node, stale contact;
+                #   - known leader alive but SILENT well past the tick
+                #     cadence (a deposed leader that never re-won);
+                #   - NO known leader after a term bump (a failed
+                #     election left everyone leaderless). term > 0 keeps
+                #     fresh boots quiet until explicitly triggered.
                 from ra_tpu.server import AWAIT_CONDITION, FOLLOWER
 
                 now = _t.monotonic()
+                contact_window = max(
+                    5 * self.tick_interval_s, 6 * self.election_timeout_s
+                )
                 for proc in list(self.procs.values()):
                     srv = proc.server
-                    leader = srv.leader_id
                     if (
-                        srv.role in (FOLLOWER, AWAIT_CONDITION)
-                        and leader is not None
-                        and leader != srv.id
-                        and srv.is_voter_self()
-                        and proc._election_ref is None
-                        and not self.transport.node_alive(leader[1])
-                        and now - proc.last_leader_contact > 2 * self.election_timeout_s
+                        srv.role not in (FOLLOWER, AWAIT_CONDITION)
+                        or not srv.is_voter_self()
+                        or proc._election_ref is not None
                     ):
+                        continue
+                    leader = srv.leader_id
+                    stale = now - proc.last_leader_contact
+                    if leader is not None and leader != srv.id:
+                        if (
+                            not self.transport.node_alive(leader[1])
+                            and stale > 2 * self.election_timeout_s
+                        ) or stale > contact_window:
+                            proc.arm_election_timer()
+                    elif srv.current_term > 0 and stale > contact_window:
                         proc.arm_election_timer()
             except Exception:  # noqa: BLE001
                 pass

@@ -12,11 +12,13 @@ src/ra_server_proc.erl:1875-1881, 2094-2110):
   ``(to_name, from_sid, msg)``. Every frame is authenticated with a
   shared-secret cookie before it is unpickled (the counterpart of the
   Erlang distribution cookie): a frame with a bad MAC kills the
-  connection without touching pickle. **Trust model**: like the
-  reference, any peer holding the cookie is fully trusted — pickle
-  grants authenticated peers arbitrary code execution, so set a secret
-  cookie (``RA_TPU_COOKIE`` env or the ``cookie=`` arg) and run on a
-  trusted network; the built-in default cookie only keeps out strays;
+  connection without touching pickle. **Trust model**: inbound frames
+  deserialize through a RESTRICTED unpickler — only the protocol/effect
+  vocabulary, plain containers, and application-registered payload
+  types resolve (``register_wire_type``); a cookie holder cannot smuggle
+  os/subprocess/functools gadget chains. Still set a secret cookie
+  (``RA_TPU_COOKIE`` env or the ``cookie=`` arg): authenticated peers
+  can of course drive the full management plane;
 - sends are async and never block the caller: each peer has a bounded
   outbox drained by a writer thread — when the outbox overflows, sends
   report failure (the peer status flips, exactly like distribution
@@ -46,6 +48,15 @@ from ra_tpu.protocol import ServerId
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
 _MAC_LEN = 16  # truncated HMAC-SHA256 prefix on every frame
+
+# restricted wire deserialization: see ra_tpu.utils.wire (inbound
+# frames resolve classes through an allowlist — a cookie holder cannot
+# smuggle gadget chains). Re-exported here for discoverability.
+from ra_tpu.utils.wire import (  # noqa: F401 (re-export)
+    _extra_wire_types,
+    register_wire_type,
+    wire_loads as _wire_loads,
+)
 
 
 class _Peer:
@@ -351,7 +362,7 @@ class TcpTransport:
                     if payload is None:
                         return  # unauthenticated frame: drop connection
                     try:
-                        to_name, from_sid, msg = pickle.loads(payload)
+                        to_name, from_sid, msg = _wire_loads(payload)
                     except Exception:  # noqa: BLE001
                         return
                     if to_name == "__ping__":

@@ -103,8 +103,16 @@ api.start_server(sid, "mpc", SimpleMachine(lambda c, s: s + c, 0), members)
 print("READY", flush=True)
 if me == "driver":
     time.sleep(1.0)  # let peers come up
-    api.trigger_election(sid)
-    api.wait_for_leader("mpc", timeout=15)
+    # under full-suite load peers may take many seconds to import jax
+    # and bind; keep triggering until a leader exists
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        api.trigger_election(sid)
+        try:
+            api.wait_for_leader("mpc", timeout=10)
+            break
+        except Exception:
+            pass
     total = 0
     for i in range(1, 6):
         r, _ = api.process_command(sid, i, timeout=15, retry_on_timeout=True)
@@ -143,10 +151,12 @@ def test_multiprocess_cluster(tmp_path):
                     env=env,
                 )
             )
-        out0, err0 = procs[0].communicate(timeout=90)
+        # generous: three jax imports + elections on a contended 1-core
+        # box (full-suite runs) need far more than the idle ~3s
+        out0, err0 = procs[0].communicate(timeout=240)
         assert "RESULT 15" in out0, (out0, err0)
-        out1, _ = procs[1].communicate(timeout=60)
-        out2, _ = procs[2].communicate(timeout=60)
+        out1, _ = procs[1].communicate(timeout=90)
+        out2, _ = procs[2].communicate(timeout=90)
         assert "CONVERGED 15" in out1
         assert "CONVERGED 15" in out2
     finally:
@@ -346,3 +356,68 @@ def test_tcp_node_alive_uses_phi_detector():
             b.close()
         except Exception:
             pass
+
+
+def test_wire_unpickler_blocks_gadget_classes():
+    """VERDICT r2 weak 7: a cookie holder must not get arbitrary code
+    execution through pickle — only allowlisted protocol/payload types
+    resolve on the wire."""
+    import pickle as _p
+
+    from ra_tpu.runtime import tcp as tcpmod
+    from ra_tpu.protocol import AppendEntriesRpc, Command, Entry, USR
+
+    # the protocol vocabulary round-trips
+    rpc = AppendEntriesRpc(term=1, leader_id=("a", "n"), prev_log_index=0,
+                           prev_log_term=0, leader_commit=0,
+                           entries=(Entry(1, 1, Command(USR, ("put", "k", 1))),))
+    out = tcpmod._wire_loads(_p.dumps(("a", ("b", "n"), rpc)))
+    assert out[2].entries[0].cmd.data == ("put", "k", 1)
+    # containers round-trip
+    assert tcpmod._wire_loads(_p.dumps({1, 2})) == {1, 2}
+    # a classic RCE gadget is rejected at find_class, never executed
+    class Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("true",))
+
+    with pytest.raises(Exception):
+        tcpmod._wire_loads(_p.dumps(Evil()))
+    # STACK_GLOBAL dotted-name traversal (protocol-4) must not tunnel
+    # through an allowlisted module to arbitrary callables
+    dotted = (b"\x80\x04" + b"\x8c\x0fra_tpu.protocol"
+              + b"\x8c\x15dataclasses.sys.intern" + b"\x93"
+              + b"\x8c\x03abc" + b"\x85" + b"R" + b".")
+    with pytest.raises(Exception):
+        tcpmod._wire_loads(dotted)
+    # module-level FUNCTIONS in allowlisted packages are not resolvable
+    # (REDUCE could invoke them with attacker args)
+    fnref = (b"\x80\x04" + b"\x8c\x0fra_tpu.protocol"
+             + b"\x8c\x11sanitize_for_wire" + b"\x93"
+             + b"\x8c\x03abc" + b"\x85" + b"R" + b".")
+    with pytest.raises(Exception):
+        tcpmod._wire_loads(fnref)
+    # snapshot-transfer bodies decode through the same allowlist
+    from ra_tpu.log.snapshot import decode_snapshot_chunks
+
+    with pytest.raises(Exception):
+        decode_snapshot_chunks([_p.dumps(Evil())])
+    assert decode_snapshot_chunks([_p.dumps({"k": 1})]) == {"k": 1}
+    # registration opens the gate for application payload types
+    blob = _p.dumps(_WirePayload(7))
+    with pytest.raises(Exception):
+        tcpmod._wire_loads(blob)
+    tcpmod.register_wire_type(_WirePayload)
+    try:
+        assert tcpmod._wire_loads(blob).v == 7
+    finally:
+        tcpmod._extra_wire_types.discard(
+            (_WirePayload.__module__, _WirePayload.__qualname__)
+        )
+
+
+class _WirePayload:
+    """Module-level so pickle can resolve it by reference."""
+
+    def __init__(self, v):
+        self.v = v
