@@ -113,7 +113,7 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
             )
             _retry_on_cpu_or_fail()  # backend is non-cpu here: re-execs
 
-    from ra_tpu.machine import SimpleMachine
+    from ra_tpu.models.bench_machine import BenchMachine
     from ra_tpu.ops import consensus as C
     from ra_tpu.protocol import Command, ElectionTimeout, USR
     from ra_tpu.runtime.coordinator import BatchCoordinator
@@ -128,7 +128,7 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
             c.add_groups(
                 [
                     (f"g{g}", f"cl{g}", members(g),
-                     SimpleMachine(lambda x, s: s + x, 0))
+                     BenchMachine())
                     for g in range(groups)
                 ]
             )
@@ -295,8 +295,12 @@ def main() -> None:
         g = args.groups or (1024 if args.smoke else 10240)
         out = bench_decisions(g, args.steps or (10 if args.smoke else 200))
     else:
+        # 48 commands in flight per group — deep pipelining is the
+        # reference harness's own methodology (PIPE_SIZE=500 in-flight
+        # per client, src/ra_bench.erl:18-19); the AER batch cap (128)
+        # still bounds every RPC
         g = args.groups or (128 if args.smoke else 10240)
-        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 20))
+        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 48))
     print(json.dumps(out))
 
 

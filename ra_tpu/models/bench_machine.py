@@ -30,6 +30,19 @@ class BenchMachine(Machine):
             return state, state, [ReleaseCursor(meta["index"], state)]
         return state, state
 
+    def apply_many(self, meta, cmds, state):
+        """O(1) batch apply for plain command runs (the pipeline hot
+        path): the machine only counts entries, so a run of n commands
+        is state+n — unless the run crosses a release-cursor boundary,
+        where we fall back to per-entry apply so the effect still
+        fires (reference: no-op apply, src/ra_bench.erl:48-55)."""
+        n = len(cmds)
+        hi = meta["index"]
+        lo = hi - n + 1
+        if (lo - 1) // RELEASE_EVERY != hi // RELEASE_EVERY:
+            return None  # boundary inside the batch: per-entry path
+        return state + n
+
     def overview(self, state):
         return {"type": "bench", "applied": state}
 

@@ -35,6 +35,7 @@ run on the actor backend (documented gap, see SURVEY §7 hard part 3).
 
 from __future__ import annotations
 
+import logging
 import pickle
 import random
 import threading
@@ -82,6 +83,8 @@ from ra_tpu.protocol import (
 )
 from ra_tpu.runtime.transport import InProcTransport, NodeRegistry, registry as node_registry
 
+logger = logging.getLogger("ra_tpu")
+
 MSG_OF_TYPE = {
     AppendEntriesRpc: C.MSG_AER,
     AppendEntriesReply: C.MSG_AER_REPLY,
@@ -105,7 +108,7 @@ class GroupHost:
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
-        "specials",
+        "specials", "last_ok_sent",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -190,6 +193,13 @@ class GroupHost:
         # take the batched fast path without scanning every entry; kept
         # exhaustive by the truncation/snapshot paths.
         self.specials: List[int] = []
+        # last success ack shipped to a leader: (sid, term, last_index,
+        # monotonic time). An identical re-ack within one tick interval
+        # is suppressed — the pipeline's commit-sync AER round otherwise
+        # triggers a reply that tells the leader nothing new. The time
+        # bound keeps the leader's silent-peer resync probe honest: a
+        # probe after 2 quiet ticks always gets a fresh ack.
+        self.last_ok_sent: Optional[Tuple[ServerId, int, int, float]] = None
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -282,6 +292,10 @@ class BatchCoordinator:
         self._pending_roles: List[Tuple[int, int]] = []
         self._hot: set = set()  # gids with queued inbox msgs / term hints
         self._applied_np = np.zeros(capacity, np.int64)  # last_applied mirror
+        # reusable mailbox pack buffer. Safe to mutate between steps:
+        # every step synchronizes on its egress (np.asarray) before the
+        # next build, so a zero-copy jnp view is never read after that.
+        self._mbox_buf: Optional[np.ndarray] = None
         # guards self.state (donated buffers!) between the step thread and
         # add_group callers
         self._state_lock = threading.Lock()
@@ -597,7 +611,19 @@ class BatchCoordinator:
         self._process_egress(eg, consumed, aer_dirty)
 
         for g, msg, from_sid in rare:
-            self._handle_rare(g, msg, from_sid)
+            # crash isolation for the slow paths (snapshot transfer
+            # decode of untrusted bytes, membership, queries): a
+            # poisoned message must not kill the step thread — every
+            # group on this coordinator would freeze (the actor backend
+            # gets the same guarantee from scheduler crash isolation)
+            try:
+                self._handle_rare(g, msg, from_sid)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "coordinator %s: dropping rare message %r for group "
+                    "%s after handler crash", self.name, type(msg).__name__,
+                    g.name,
+                )
         self._send_aers(aer_dirty)
         return True
 
@@ -935,7 +961,13 @@ class BatchCoordinator:
 
     def _build_mailbox(self):
         cap = self.capacity
-        packed = np.zeros((len(C.MBOX_FIELDS), cap), np.int32)
+        packed = self._mbox_buf
+        if packed is None:
+            packed = self._mbox_buf = np.zeros(
+                (len(C.MBOX_FIELDS), cap), np.int32
+            )
+        else:
+            packed.fill(0)
         R = self._R
         packed[R["host_term_idx"]].fill(-1)
         packed[R["host_term_val"]].fill(-1)
@@ -1278,7 +1310,19 @@ class BatchCoordinator:
         wi, wt = g.log.last_written()
         if wi >= last_entry:
             ack = min(wi, last_entry)
-            at = g.log.fetch_term(ack)
+            prev = g.last_ok_sent
+            now = time.monotonic()
+            if (
+                prev is not None
+                and prev[0] == from_sid
+                and prev[1] == term
+                and prev[2] == ack
+                and now - prev[3] < self.tick_interval_s
+            ):
+                return  # identical ack just sent: nothing new for the leader
+            g.last_ok_sent = (from_sid, term, ack, now)
+            # steady state acks exactly at the watermark: reuse its term
+            at = wt if ack == wi else g.log.fetch_term(ack)
             queue_send(
                 from_sid,
                 AppendEntriesReply(term, True, ack + 1, ack,
@@ -1533,11 +1577,16 @@ class BatchCoordinator:
                 return
             drop = self.transport.drop_fn
             with node._ingress_cv:
-                for to, msg, frm in msgs:
-                    if drop is not None and drop(to, msg):
-                        self.transport.dropped += 1
-                        continue
-                    node._ingress.append((to[0], frm, msg))
+                if drop is None:
+                    node._ingress.extend(
+                        (to[0], frm, msg) for to, msg, frm in msgs
+                    )
+                else:
+                    for to, msg, frm in msgs:
+                        if drop(to, msg):
+                            self.transport.dropped += 1
+                            continue
+                        node._ingress.append((to[0], frm, msg))
                 node._ingress_cv.notify()
             return
         for to, msg, frm in msgs:
@@ -1955,7 +2004,19 @@ class BatchCoordinator:
         # complete: install host-side, then scatter the floor to device
         from ra_tpu.log.snapshot import decode_snapshot_chunks
 
-        state_obj = decode_snapshot_chunks(acc["chunks"])
+        try:
+            state_obj = decode_snapshot_chunks(acc["chunks"])
+        except Exception:
+            # undecodable body (e.g. a machine-state type the wire
+            # allowlist does not know here): abort THIS transfer so a
+            # retry restarts from INIT; never poison the step thread
+            g.snap_accept = None
+            logger.exception(
+                "coordinator %s: snapshot body for group %s failed wire "
+                "decode; transfer aborted (register_wire_type missing?)",
+                self.name, g.name,
+            )
+            return
         meta = acc["meta"]
         g.log.install_snapshot(meta, state_obj)
         g.machine_state = state_obj

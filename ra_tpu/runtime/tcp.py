@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import logging
 import os
 import pickle
 import socket
@@ -45,6 +46,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from ra_tpu.protocol import ServerId
 
+logger = logging.getLogger("ra_tpu")
+
 _LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
 _MAC_LEN = 16  # truncated HMAC-SHA256 prefix on every frame
@@ -53,8 +56,8 @@ _MAC_LEN = 16  # truncated HMAC-SHA256 prefix on every frame
 # frames resolve classes through an allowlist — a cookie holder cannot
 # smuggle gadget chains). Re-exported here for discoverability.
 from ra_tpu.utils.wire import (  # noqa: F401 (re-export)
-    _extra_wire_types,
     register_wire_type,
+    unregister_wire_type,
     wire_loads as _wire_loads,
 )
 
@@ -364,6 +367,16 @@ class TcpTransport:
                     try:
                         to_name, from_sid, msg = _wire_loads(payload)
                     except Exception:  # noqa: BLE001
+                        # with the wire allowlist this is the primary
+                        # failure mode for LEGITIMATE traffic carrying an
+                        # unregistered payload type — never drop silently
+                        # (the peer would reconnect and loop forever)
+                        logger.exception(
+                            "tcp %s: dropping connection on frame decode "
+                            "failure (unregistered wire type? see "
+                            "ra_tpu.utils.wire.register_wire_type)",
+                            self.node_name,
+                        )
                         return
                     if to_name == "__ping__":
                         self._enqueue_control(from_sid, "__pong__")

@@ -39,6 +39,11 @@ def register_wire_type(cls) -> None:
     _extra_wire_types.add((cls.__module__, cls.__qualname__))
 
 
+def unregister_wire_type(cls) -> None:
+    """Remove a previously registered wire type (tests / teardown)."""
+    _extra_wire_types.discard((cls.__module__, cls.__qualname__))
+
+
 class _RestrictedUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         if (module, name) in _extra_wire_types:

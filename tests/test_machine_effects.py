@@ -140,12 +140,12 @@ def test_demonitor_stops_down_delivery(cluster):
     )
 
 
-def test_monitor_node_delivers_nodedown_builtin(cluster):
+def test_monitor_node_delivers_nodedown_builtin(cluster, tmp_path):
     ids = cluster
     # monitor a node OUTSIDE the cluster's own membership so stopping it
     # does not disturb quorum
     extra = "me_extra"
-    api.start_node(extra, SystemConfig(name="meffx"),
+    api.start_node(extra, SystemConfig(name="meffx", data_dir=str(tmp_path / "x")),
                    election_timeout_s=0.1, detector_poll_s=0.05)
     try:
         r, _ = api.process_command(ids[0], ("monitor_node", extra), timeout=10)

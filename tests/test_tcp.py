@@ -16,6 +16,7 @@ import pytest
 from ra_tpu import api, leaderboard
 from ra_tpu.machine import SimpleMachine
 from ra_tpu.system import SystemConfig
+from ra_tpu.utils.wire import unregister_wire_type
 
 
 def free_port():
@@ -386,16 +387,16 @@ def test_wire_unpickler_blocks_gadget_classes():
     # STACK_GLOBAL dotted-name traversal (protocol-4) must not tunnel
     # through an allowlisted module to arbitrary callables
     dotted = (b"\x80\x04" + b"\x8c\x0fra_tpu.protocol"
-              + b"\x8c\x15dataclasses.sys.intern" + b"\x93"
+              + b"\x8c\x16dataclasses.sys.intern" + b"\x93"
               + b"\x8c\x03abc" + b"\x85" + b"R" + b".")
-    with pytest.raises(Exception):
+    with pytest.raises(_p.UnpicklingError, match="not allowlisted"):
         tcpmod._wire_loads(dotted)
     # module-level FUNCTIONS in allowlisted packages are not resolvable
     # (REDUCE could invoke them with attacker args)
     fnref = (b"\x80\x04" + b"\x8c\x0fra_tpu.protocol"
              + b"\x8c\x11sanitize_for_wire" + b"\x93"
              + b"\x8c\x03abc" + b"\x85" + b"R" + b".")
-    with pytest.raises(Exception):
+    with pytest.raises(_p.UnpicklingError, match="not allowlisted"):
         tcpmod._wire_loads(fnref)
     # snapshot-transfer bodies decode through the same allowlist
     from ra_tpu.log.snapshot import decode_snapshot_chunks
@@ -411,9 +412,7 @@ def test_wire_unpickler_blocks_gadget_classes():
     try:
         assert tcpmod._wire_loads(blob).v == 7
     finally:
-        tcpmod._extra_wire_types.discard(
-            (_WirePayload.__module__, _WirePayload.__qualname__)
-        )
+        unregister_wire_type(_WirePayload)
 
 
 class _WirePayload:
