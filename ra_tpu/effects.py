@@ -107,6 +107,22 @@ class LogRead(Effect):
 class ReleaseCursor(Effect):
     index: int
     machine_state: Any
+    # optional gating conditions (reference: conditional release
+    # cursors, src/ra_server.erl:2455-2479): ("written", idx) defers
+    # until the log's durable watermark covers idx; "no_snapshot_sends"
+    # defers while any peer is mid-snapshot-transfer. Unmet conditions
+    # stash the cursor; it re-fires when they become true.
+    conditions: Tuple[Any, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class StartSnapshotRetryTimer(Effect):
+    """Arm a retry for a peer whose snapshot sender died (reference:
+    start_snapshot_retry_timer, src/ra_server.erl:204, exponential
+    5000*2^(n-1) ms capped at 60 s)."""
+
+    to: Any
+    delay_ms: int
 
 
 @dataclasses.dataclass(frozen=True)

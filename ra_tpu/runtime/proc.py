@@ -45,6 +45,7 @@ from ra_tpu.protocol import (
 from ra_tpu.server import (
     AWAIT_CONDITION,
     CANDIDATE,
+    ConditionTimeout,
     FOLLOWER,
     LEADER,
     PRE_VOTE,
@@ -189,7 +190,14 @@ class ServerProc:
         self.tick_interval_s = node.tick_interval_s
         self.election_timeout_s = node.election_timeout_s
         self.snapshot_ack_timeout_s = 120.0
+        # default await_condition hold before the condition's timeout
+        # path runs (reference: ?DEFAULT_AWAIT_CONDITION_TIMEOUT 30 s,
+        # src/ra_server_proc.erl:69); a Condition can override per-hold
+        self.await_condition_timeout_s = getattr(
+            node, "await_condition_timeout_s", 30.0
+        )
         self._election_ref: Optional[int] = None
+        self._condition_ref: Optional[int] = None
         self._tick_ref: Optional[int] = None
         self.last_leader_contact: float = time.monotonic()
         # commit-rate gauge (reference: ra_li leaky integrator driving the
@@ -201,6 +209,7 @@ class ServerProc:
         # measures new traffic, not the entire recovered history
         self._last_commit_sample = (time.monotonic(), server.commit_index)
         self._senders: Dict[ServerId, SnapshotSender] = {}
+        self._snap_retry: Dict[ServerId, Any] = {}  # peer -> retry timer ref
         self._machine_timers: Dict[Any, int] = {}
         # buffered low-priority commands (reference: ra_ets_queue)
         from collections import deque as _deque
@@ -368,10 +377,9 @@ class ServerProc:
             return self.server.handle(result, from_peer=to)
         _, to = msg
         self._senders.pop(to, None)
-        peer = self.server.cluster.get(to)
-        if peer is not None and peer.status == "sending_snapshot":
-            peer.status = "normal"  # retried on a later pipeline pass
-        return []
+        # exponential backoff instead of an immediate pipeline retry
+        # (reference: snapshot_sender_exponential_backoff)
+        return self.server.handle(("snapshot_sender_down", to, "failed"))
 
     # ------------------------------------------------------------------
     # effect executor (reference: handle_effects src/ra_server_proc.erl:1530)
@@ -410,6 +418,8 @@ class ServerProc:
                     target=self._stop_self, name=f"ra-stop-{self.name}",
                     daemon=True,
                 ).start()
+            elif isinstance(eff, fx.StartSnapshotRetryTimer):
+                self._arm_snapshot_retry(eff.to, eff.delay_ms)
             elif isinstance(eff, fx.Timer):
                 self._machine_timer(eff)
             elif isinstance(eff, fx.ModCall):
@@ -477,6 +487,11 @@ class ServerProc:
         if self.running:
             self.enqueue(ElectionTimeout())
 
+    def _on_condition_timeout(self, generation: int) -> None:
+        self._condition_ref = None
+        if self.running:
+            self.enqueue(ConditionTimeout(generation=generation))
+
     def _on_state_enter(self, role: str) -> None:
         if role != LEADER and self._low_q:
             # leadership lost with lows still buffered: drop them —
@@ -491,12 +506,38 @@ class ServerProc:
                 if fut is not None:
                     self._reply(fut, ("redirect", leader))
             self._low_q.clear()
+        if role != AWAIT_CONDITION and self._condition_ref is not None:
+            self.timers.cancel(self._condition_ref)
+            self._condition_ref = None
         if role in (PRE_VOTE, CANDIDATE):
             self.arm_election_timer()  # retry a stalled election round
-        elif role == "await_condition":
-            # the election timeout doubles as the condition timeout
-            # (server._handle_await_condition falls back to follower)
-            self.arm_election_timer()
+        elif role == AWAIT_CONDITION:
+            # the condition timer runs the Condition's timeout path
+            # (repeating a catch-up failure reply, falling back to
+            # leader); the election timer is armed ONLY with leaderless
+            # evidence — a transferring ex-leader or a holding follower
+            # whose leader is alive must not start disruptive pre-votes
+            # (the failure detector arms it if the leader dies later)
+            leader = self.server.leader_id
+            if (
+                leader is not None
+                and leader != self.server.id
+                and not self.transport.proc_alive(leader)
+                and self.server.is_voter_self()
+            ):
+                self.arm_election_timer()
+            else:
+                self.timers.cancel(self._election_ref)
+                self._election_ref = None
+            cond = self.server.condition
+            dur_s = self.await_condition_timeout_s
+            if cond is not None and cond.timeout_duration_ms is not None:
+                dur_s = cond.timeout_duration_ms / 1000.0
+            gen = self.server.condition_generation
+            self.timers.cancel(self._condition_ref)
+            self._condition_ref = self.timers.after(
+                dur_s, lambda: self._on_condition_timeout(gen)
+            )
         elif role == LEADER:
             self.timers.cancel(self._election_ref)
             self._election_ref = None
@@ -532,15 +573,35 @@ class ServerProc:
 
     # ------------------------------------------------------------------
 
+    def _arm_snapshot_retry(self, to: ServerId, delay_ms: int) -> None:
+        old = self._snap_retry.pop(to, None)
+        self.timers.cancel(old)
+
+        def fire():
+            self._snap_retry.pop(to, None)
+            if self.running:
+                self.enqueue(("snapshot_retry_timeout", to))
+
+        self._snap_retry[to] = self.timers.after(delay_ms / 1000.0, fire)
+
     def _start_snapshot_sender(self, to: ServerId) -> None:
+        from ra_tpu.server import status_kind
+
         if to in self._senders:
             return
+        old = self._snap_retry.pop(to, None)
+        self.timers.cancel(old)
+        peer = self.server.cluster.get(to)
+        # a retry emits SendSnapshot while the peer still carries its
+        # snapshot_backoff count; the send flips it to sending_snapshot
+        # WITH the count so another death keeps backing off
+        if peer is not None and status_kind(peer.status) == "snapshot_backoff":
+            peer.status = ("sending_snapshot", peer.status[1])
         # capture the payload here, on the proc thread: the log is
         # single-owner and must not be read from the sender thread
         got = self.server.log.read_snapshot()
         if got is None:
-            peer = self.server.cluster.get(to)
-            if peer is not None and peer.status == "sending_snapshot":
+            if peer is not None and status_kind(peer.status) == "sending_snapshot":
                 peer.status = "normal"
             return
         meta, state = got

@@ -45,6 +45,7 @@ from ra_tpu.effects import (
     SendRpc,
     SendSnapshot,
     SendVoteRequests,
+    StartSnapshotRetryTimer,
     StateEnter,
     StopServer as StopEffect,
     Timer,
@@ -98,14 +99,23 @@ RECEIVE_SNAPSHOT = "receive_snapshot"
 AWAIT_CONDITION = "await_condition"
 
 
+def status_kind(status: Any) -> str:
+    """Peer status discriminator: plain statuses are strings; the
+    snapshot-transfer statuses carry an attempt count as
+    ("sending_snapshot", n) / ("snapshot_backoff", n) (reference peer
+    status values, src/ra_server.erl:73-112)."""
+    return status[0] if isinstance(status, tuple) else status
+
+
 @dataclasses.dataclass
 class PeerState:
     next_index: int = 1
     match_index: int = 0
     commit_index_sent: int = 0
     query_index: int = 0
-    # "normal" | "sending_snapshot" | "suspended" | "disconnected"
-    status: str = "normal"
+    # "normal" | "suspended" | "disconnected"
+    # | ("sending_snapshot", attempts) | ("snapshot_backoff", attempts)
+    status: Any = "normal"
     # "voter" | ("nonvoter", target_index) — nonvoters replicate but do
     # not count for quorum/elections until promoted (reference:
     # maybe_promote_peer src/ra_server.erl:3977-3995)
@@ -125,9 +135,63 @@ class TimeoutNow:
 
 
 @dataclasses.dataclass
+class ConditionTimeout:
+    """Fired by the runtime when the await_condition hold expires —
+    distinct from ElectionTimeout, which starts a pre-vote even while a
+    condition holds (reference: await_condition_timeout vs
+    election_timeout, src/ra_server.erl:1922-1945).
+
+    ``generation`` guards against stale delivery: a timeout enqueued for
+    hold A must not expire a newly-entered hold B (None = wildcard, for
+    message-level tests)."""
+
+    generation: Optional[int] = None
+
+
+@dataclasses.dataclass
 class Condition:
+    """An await_condition hold (reference condition map,
+    src/ra_server.erl:90-93): ``predicate(server, msg)`` decides when a
+    message releases the hold; the server then transitions to
+    ``transition_to`` and re-injects the message. If the hold expires
+    first (ConditionTimeout), the server transitions to
+    ``timeout_transition_to`` and issues ``timeout_effects`` (e.g. the
+    catch-up condition repeats its failure reply)."""
+
     predicate: Callable[["Server", Any], bool]
     timeout_effects: Tuple[Effect, ...] = ()
+    transition_to: str = FOLLOWER
+    timeout_transition_to: str = FOLLOWER
+    # None -> the runtime's default await_condition timeout
+    timeout_duration_ms: Optional[int] = None
+
+
+def _follower_catchup_cond(reason: str) -> Callable[["Server", Any], bool]:
+    """Release predicate for the follower catch-up hold (reference:
+    follower_catchup_cond, src/ra_server.erl:2196-2231): a same/higher
+    term AER whose prev now fits releases; a term-mismatch AER releases
+    only when the original hold was for a MISSING entry (the mismatch
+    needs its own rewind); an install-snapshot at/above our next index
+    releases into the snapshot path."""
+
+    def pred(srv: "Server", m: Any) -> bool:
+        if isinstance(m, AppendEntriesRpc) and m.term >= srv.current_term:
+            snap = srv.log.snapshot_index_term()
+            local = srv.log.fetch_term(m.prev_log_index)
+            code = dec.aer_decision(
+                srv.current_term, m.term, m.prev_log_index, m.prev_log_term,
+                -1 if local is None else local, snap[0] if snap else 0,
+            )
+            if code == dec.AER_OK:
+                return True
+            if local is not None and local != m.prev_log_term:
+                return reason == "missing"
+            return False
+        if isinstance(m, InstallSnapshotRpc) and m.term >= srv.current_term:
+            return m.meta.index >= srv.log.next_index()
+        return False
+
+    return pred
 
 
 @dataclasses.dataclass
@@ -200,6 +264,12 @@ class Server:
         self._snap_accept: Optional[Dict[str, Any]] = None
 
         self.condition: Optional[Condition] = None
+        self.condition_generation = 0  # stale-ConditionTimeout guard
+        # a release cursor stashed behind unmet conditions:
+        # (index, machine_state, conditions) — re-evaluated on written
+        # events, AER acks, and snapshot-sender exits (reference:
+        # pending_release_cursor, src/ra_server.erl:2455-2514)
+        self.pending_release_cursor: Optional[Tuple[int, Any, Tuple[Any, ...]]] = None
 
         self.counter = (
             ra_counters.new((cfg.cluster_name, cfg.server_id)) if cfg.counters_enabled else None
@@ -489,6 +559,11 @@ class Server:
                 peer.status = "normal"
                 peer.match_index = max(peer.match_index, msg.last_index)
                 peer.next_index = max(peer.next_index, msg.last_index + 1)
+                self._maybe_emit_pending_release_cursor()  # no_snapshot_sends
+                # a snapshot can carry a nonvoter past its promotion
+                # target just like an AER ack (reference: leader_received_
+                # install_snapshot_result_and_promotes_voter)
+                self._maybe_promote_peer(from_peer, peer, effects)
                 self._evaluate_quorum(effects)
                 self._pipeline(effects)
             return effects
@@ -540,6 +615,7 @@ class Server:
             return effects
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
+            self._maybe_emit_pending_release_cursor()  # ("written", idx)
             self._evaluate_quorum(effects)
             self._pipeline(effects)
             return effects
@@ -629,6 +705,7 @@ class Server:
             peer.next_index = max(peer.next_index, msg.last_index + 1)
             if peer.status == "suspended":
                 peer.status = "normal"
+            self._maybe_emit_pending_release_cursor()
             self._maybe_promote_peer(from_peer, peer, effects)
             self._evaluate_quorum(effects)
         else:
@@ -692,6 +769,34 @@ class Server:
 
     def _leader_control(self, msg: tuple, effects: EffectList) -> EffectList:
         kind = msg[0]
+        if kind == "snapshot_sender_down":
+            # routed by the runtime's monitor plumbing when a transfer
+            # thread exits (reference: handle_down snapshot_sender,
+            # src/ra_server.erl:2640-2660)
+            _, sid, reason = msg
+            peer = self.cluster.get(sid)
+            if peer is None or status_kind(peer.status) != "sending_snapshot":
+                return effects
+            if reason == "normal":
+                peer.status = "normal"
+                self._maybe_emit_pending_release_cursor()
+            else:
+                # exponential backoff: 5000 * 2^(n-1) ms capped at 60 s
+                attempts = peer.status[1] + 1
+                peer.status = ("snapshot_backoff", attempts)
+                delay = min(5000 * (1 << (attempts - 1)), 60000)
+                self._c("snapshot_send_failures")
+                effects.append(StartSnapshotRetryTimer(sid, delay))
+            return effects
+        if kind == "snapshot_retry_timeout":
+            _, sid = msg
+            peer = self.cluster.get(sid)
+            if peer is not None and status_kind(peer.status) == "snapshot_backoff":
+                # keep the backoff status: the send-effect handler reads
+                # the attempt count from it (reference:
+                # snapshot_backoff_prevents_immediate_retry)
+                effects.append(SendSnapshot(sid, meta=self.log.snapshot_meta()))
+            return effects
         if kind == "consistent_query":
             _, fn, from_ref = msg
             self.query_index += 1
@@ -712,9 +817,45 @@ class Server:
                 if from_ref is not None:
                     effects.append(Reply(from_ref, ("error", "unknown_member")))
                 return effects
-            effects.append(SendRpc(target, TimeoutNow()))
+            peer = self.cluster[target]
+            if not peer.is_voter():
+                if from_ref is not None:
+                    effects.append(Reply(from_ref, ("error", "non_voter")))
+                return effects
+            if peer.match_index + 1 != self.log.next_index():
+                # only a CONFIRMED-caught-up voter may take over
+                # (match_index, not the optimistically-advanced
+                # next_index — a peer that was pipelined to but never
+                # acked must not pass)
+                if from_ref is not None:
+                    effects.append(Reply(from_ref, ("error", "not_up_to_date")))
+                return effects
             if from_ref is not None:
                 effects.append(Reply(from_ref, ("ok", None)))
+            effects.append(SendRpc(target, TimeoutNow()))
+            # hold while the hand-off is in flight: the target's
+            # higher-term vote/AER releases the hold into follower; if
+            # nothing arrives, fall back to leading (reference:
+            # transfer_leadership_condition, src/ra_server.erl:1015-1035,
+            # 2233-2243)
+
+            def transfer_cond(srv: "Server", m: Any) -> bool:
+                return (
+                    isinstance(m, (AppendEntriesRpc, InstallSnapshotRpc))
+                    and m.term > srv.current_term
+                )
+
+            self.await_condition(
+                Condition(
+                    predicate=transfer_cond,
+                    timeout_transition_to=LEADER,
+                    # short hold: if the TimeoutNow was lost, resume
+                    # leading after 5 s rather than the 30 s default
+                    # (the held leader is alive, so no peer elects)
+                    timeout_duration_ms=5000,
+                ),
+                effects,
+            )
             return effects
         if kind == "aux":
             _, aux_kind, cmd, from_ref = msg
@@ -795,7 +936,10 @@ class Server:
         make_pipelined_rpc_effects src/ra_server.erl:2285-2434)."""
         last_idx, _ = self.log.last_index_term()
         for sid, peer in self.peers().items():
-            if peer.status in ("sending_snapshot", "suspended", "disconnected"):
+            if status_kind(peer.status) in (
+                "sending_snapshot", "snapshot_backoff", "suspended",
+                "disconnected",
+            ):
                 continue
             sent_any = False
             while (
@@ -819,8 +963,13 @@ class Server:
         if prev_term is None or (snap is not None and prev_idx < snap[0]):
             # prev entry compacted away: peer needs a snapshot
             # (reference: make_rpc_effect snapshot branch
-            # src/ra_server.erl:2392-2415)
-            peer.status = "sending_snapshot"
+            # src/ra_server.erl:2392-2415). Carry the attempt count
+            # across retries so repeated sender deaths keep backing off.
+            attempts = (
+                peer.status[1] if status_kind(peer.status) == "snapshot_backoff"
+                else 0
+            )
+            peer.status = ("sending_snapshot", attempts)
             effects.append(SendSnapshot(sid, meta=self.log.snapshot_meta()))
             return False
         entries: Tuple[Entry, ...] = ()
@@ -964,15 +1113,16 @@ class Server:
         out: List[Effect] = []
         for eff in mac_effects:
             if isinstance(eff, ReleaseCursor):
-                mac = self.machine.which_module(self.effective_machine_version)
-                self.log.update_release_cursor(
-                    eff.index,
-                    tuple(self.members()),
-                    self.effective_machine_version,
-                    eff.machine_state,
-                    live_indexes=tuple(mac.live_indexes(eff.machine_state)),
-                )
-                self._c("releases")
+                conds = tuple(getattr(eff, "conditions", ()) or ())
+                if conds and not self._release_cursor_conditions_met(conds):
+                    # stash until the conditions hold (reference:
+                    # update_release_cursor_with_written_condition /
+                    # _no_snapshot_sends_condition)
+                    self.pending_release_cursor = (
+                        eff.index, eff.machine_state, conds
+                    )
+                    continue
+                self._do_release_cursor(eff.index, eff.machine_state)
             elif isinstance(eff, Checkpoint):
                 mac = self.machine.which_module(self.effective_machine_version)
                 self.log.checkpoint(
@@ -986,6 +1136,36 @@ class Server:
             else:
                 out.append(eff)
         return out
+
+    def _do_release_cursor(self, index: int, machine_state: Any) -> None:
+        mac = self.machine.which_module(self.effective_machine_version)
+        self.log.update_release_cursor(
+            index,
+            tuple(self.members()),
+            self.effective_machine_version,
+            machine_state,
+            live_indexes=tuple(mac.live_indexes(machine_state)),
+        )
+        self._c("releases")
+
+    def _release_cursor_conditions_met(self, conds: Tuple[Any, ...]) -> bool:
+        for c in conds:
+            if c == "no_snapshot_sends":
+                if any(
+                    status_kind(p.status) == "sending_snapshot"
+                    for p in self.cluster.values()
+                ):
+                    return False
+            elif isinstance(c, tuple) and c and c[0] == "written":
+                if self.log.last_written()[0] < c[1]:
+                    return False
+        return True
+
+    def _maybe_emit_pending_release_cursor(self) -> None:
+        pend = self.pending_release_cursor
+        if pend is not None and self._release_cursor_conditions_met(pend[2]):
+            self.pending_release_cursor = None
+            self._do_release_cursor(pend[0], pend[1])
 
     def _reply_applied(
         self,
@@ -1033,6 +1213,7 @@ class Server:
             return effects
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
+            self._maybe_emit_pending_release_cursor()  # ("written", idx)
             self._follower_send_written_reply(effects)
             self._apply_to(self.commit_index, effects=effects)
             return effects
@@ -1097,11 +1278,23 @@ class Server:
         if code in (dec.AER_MISMATCH, dec.AER_BEHIND_SNAPSHOT):
             self._c("aer_replies_failed")
             nid = dec.aer_failure_next_index(self.commit_index, li, msg.prev_log_index, snap_idx)
-            effects.append(
-                SendRpc(
-                    from_peer,
-                    AppendEntriesReply(self.current_term, False, nid, li, lt),
-                )
+            reply = SendRpc(
+                from_peer,
+                AppendEntriesReply(self.current_term, False, nid, li, lt),
+            )
+            effects.append(reply)
+            # hold in await_condition while the requested resend is in
+            # flight: repeated failing AERs must not trigger one rewind
+            # each (reference: follower_catchup_cond,
+            # src/ra_server.erl:1390-1428, 2196-2231). The failure reply
+            # above still goes out now; the condition timeout repeats it.
+            reason = "missing" if local_prev_term is None else "term_mismatch"
+            self.await_condition(
+                Condition(
+                    predicate=_follower_catchup_cond(reason),
+                    timeout_effects=(reply,),
+                ),
+                effects,
             )
             return effects
         # AER_OK: drop already-matching entries, truncate on divergence,
@@ -1117,6 +1310,19 @@ class Server:
             to_write.append(e)
         last_entry_idx = msg.entries[-1].index if msg.entries else msg.prev_log_index
         if to_write:
+            if to_write[0].index <= li:
+                # overwriting a divergent suffix: an uncommitted cluster
+                # change adopted from that suffix must be rolled back
+                # before the replacement entries are scanned (reference:
+                # follower_cluster_change_overwrite_updates_membership;
+                # one-at-a-time changes mean depth-1 history suffices —
+                # committed changes can never be overwritten)
+                ci = self.cluster_index_term[0]
+                if ci >= to_write[0].index and self.previous_cluster is not None:
+                    pidx, pterm, pcluster = self.previous_cluster
+                    if pidx < to_write[0].index:
+                        self._set_cluster(pcluster, pidx, pterm)
+                        self.previous_cluster = None
             self.log.write(to_write)
             li, lt = self.log.last_index_term()
         self.commit_index = max(self.commit_index, min(msg.leader_commit, last_entry_idx))
@@ -1600,13 +1806,9 @@ class Server:
     def _handle_await_condition(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
         effects: EffectList = []
         cond = self.condition
-        if isinstance(msg, ElectionTimeout):  # doubles as condition timeout
-            self.condition = None
-            self._become_follower(effects)
-            if cond is not None:
-                effects.extend(cond.timeout_effects)
-            return effects
-        if cond is not None and cond.predicate(self, msg):
+        if isinstance(msg, RequestVoteRpc):
+            # an election is under way: leave the hold and process the
+            # vote as a follower (reference: src/ra_server.erl:1918)
             self.condition = None
             self._become_follower(effects)
             effects.append(NextEvent(FromPeer(from_peer, msg) if from_peer else msg))
@@ -1615,13 +1817,61 @@ class Server:
             # liveness: a waiting server must still answer pre-vote
             # probes (reference: await_condition_receives_pre_vote)
             return self._process_pre_vote(msg, from_peer, effects)
+        if isinstance(msg, ElectionTimeout):
+            # a held server still suspects dead leaders: full pre-vote
+            # round, NOT the condition's timeout path (reference:
+            # src/ra_server.erl:1922-1931; nonvoters never elect)
+            if not self.is_voter_self():
+                return effects
+            self.condition = None
+            return self._call_for_election_or_pre_vote(effects)
+        if isinstance(msg, ConditionTimeout):
+            if (
+                msg.generation is not None
+                and msg.generation != self.condition_generation
+            ):
+                return effects  # stale: armed for an earlier hold
+            self.condition = None
+            if cond is not None and cond.predicate(self, msg):
+                self._exit_condition(cond.transition_to, effects)
+                return effects
+            self._exit_condition(
+                cond.timeout_transition_to if cond else FOLLOWER, effects
+            )
+            if cond is not None:
+                effects.extend(cond.timeout_effects)
+            return effects
+        if cond is not None and cond.predicate(self, msg):
+            self.condition = None
+            self._exit_condition(cond.transition_to, effects)
+            effects.append(NextEvent(FromPeer(from_peer, msg) if from_peer else msg))
+            return effects
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
+            self._maybe_emit_pending_release_cursor()  # ("written", idx)
+            return effects
+        if isinstance(msg, Command) and msg.from_ref is not None:
+            # never strand a caller while held: redirect so the client
+            # retries against whatever leader emerges
+            effects.append(Reply(msg.from_ref, ("redirect", None)))
             return effects
         return effects
 
+    def _exit_condition(self, role: str, effects: EffectList) -> None:
+        if role == LEADER:
+            # returning to leadership after a hold (transfer timed out /
+            # WAL recovered) re-enters WITHOUT the fresh-election reset:
+            # peer bookkeeping, cluster_change_permitted, and the
+            # noop gate are retained, and no new noop is appended
+            # (reference: leader_enters_from_await_condition)
+            self._become(LEADER, effects)
+            self._pipeline(effects)
+        else:
+            self._become_follower(effects)
+
     def await_condition(self, cond: Condition, effects: EffectList) -> None:
         self.condition = cond
+        self.condition_generation += 1
         self._become(AWAIT_CONDITION, effects)
 
     def _on_wal_down(self) -> EffectList:

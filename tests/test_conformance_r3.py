@@ -50,7 +50,9 @@ from ra_tpu.protocol import (
     USR,
 )
 from ra_tpu.server import (
+    AWAIT_CONDITION,
     CANDIDATE,
+    ConditionTimeout,
     FOLLOWER,
     LEADER,
     PRE_VOTE,
@@ -184,6 +186,11 @@ def test_follower_aer_term_mismatch_at_snapshot_boundary():
     replies = sent(effects, AppendEntriesReply)
     assert replies and not replies[0].success
     assert s.last_applied == 5 and s.log.snapshot_index_term() == (5, 2)
+    # the reject hint never points below the snapshot floor, and the
+    # follower holds for the resend (reference:
+    # follower_aer_term_mismatch_snapshot — rewind + await_condition)
+    assert replies[0].next_index >= 6
+    assert s.role == AWAIT_CONDITION
 
 
 def test_follower_aer_below_snapshot_hints_snapshot_floor():
@@ -733,3 +740,392 @@ def test_checkpoint_retention_cap(store):
     assert len(entries) == store.max_checkpoints
     # the newest survive
     assert entries[-1][0] == store.max_checkpoints + 4
+
+
+# ---------------------------------------------------------------------------
+# await_condition conformance: the follower catch-up hold, leadership
+# transfer hold, and leader re-entry (reference:
+# follower_catchup_condition, transfer_leadership,
+# leader_enters_from_await_condition, await_condition_heartbeat_reply_
+# dropped — test/ra_server_SUITE.erl)
+
+
+def catchup_hold(s, leader=S2):
+    """Drive a follower with [1..3] into the catch-up hold via a gap."""
+    handle_all(s, aer(entries=[ent(1, 1, 1), ent(2, 1, 2), ent(3, 1, 3)]),
+               from_peer=leader)
+    effects = s.handle(aer(prev=5, prev_term=1, entries=[ent(6, 1, 6)]),
+                       from_peer=leader)
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and not replies[-1].success
+    assert s.role == AWAIT_CONDITION
+    return replies[-1]
+
+
+def test_follower_catchup_condition_absorbs_repeat_gap_aers():
+    s = mk()
+    catchup_hold(s)
+    # further too-far AERs are absorbed without one rewind/reply each
+    effects = s.handle(aer(prev=6, prev_term=1, entries=[ent(7, 1, 7)]),
+                       from_peer=S2)
+    assert sent(effects, AppendEntriesReply) == []
+    assert s.role == AWAIT_CONDITION
+    # ...and a LOWER-term AER neither releases nor answers
+    effects = s.handle(aer(term=0, prev=3, prev_term=1), from_peer=S2)
+    assert sent(effects, AppendEntriesReply) == []
+    assert s.role == AWAIT_CONDITION
+
+
+def test_follower_catchup_condition_releases_on_fitting_aer():
+    s = mk()
+    catchup_hold(s)
+    handle_all(s, aer(prev=3, prev_term=1, commit=4,
+                      entries=[ent(4, 1, 4), ent(5, 1, 5), ent(6, 1, 6)]),
+               from_peer=S2)
+    assert s.role == FOLLOWER
+    assert s.log.last_index_term()[0] == 6
+    assert s.commit_index == 4
+
+
+def test_follower_catchup_condition_releases_on_snapshot():
+    s = mk()
+    catchup_hold(s)
+    # an install-snapshot at/above our next index releases into the
+    # snapshot path (re-injected; first chunk moves to receive_snapshot)
+    install_snapshot(s, snap_meta(idx=9, term=1), 99, term=1)
+    assert s.role == FOLLOWER
+    assert s.last_applied == 9 and s.machine_state == 99
+
+
+def test_catchup_condition_timeout_repeats_reply_and_exits():
+    s = mk()
+    first = catchup_hold(s)
+    effects = s.handle(ConditionTimeout())
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and not replies[-1].success
+    assert replies[-1].next_index == first.next_index
+    assert s.role == FOLLOWER
+
+
+def test_await_condition_election_timeout_starts_pre_vote():
+    s = mk()
+    catchup_hold(s)
+    s.handle(ElectionTimeout())
+    assert s.role == PRE_VOTE
+
+
+def test_await_condition_request_vote_exits_and_votes():
+    s = mk()
+    catchup_hold(s)
+    effects = handle_all(
+        s,
+        RequestVoteRpc(term=2, candidate_id=S3, last_log_index=9,
+                       last_log_term=1),
+        from_peer=S3,
+    )
+    assert s.role == FOLLOWER
+    grants = [m for m in sent(effects, RequestVoteResult) if m.vote_granted]
+    assert grants and s.voted_for == S3
+
+
+def test_await_condition_heartbeat_reply_dropped():
+    s = mk()
+    catchup_hold(s)
+    effects = s.handle(HeartbeatReply(term=1, query_index=1), from_peer=S2)
+    assert sent(effects, (AppendEntriesReply, HeartbeatReply)) == []
+    assert s.role == AWAIT_CONDITION
+
+
+def replies_of(effects):
+    return [e.reply for e in effects if isinstance(e, Reply)]
+
+
+def test_transfer_leadership_rejects_non_voter_and_laggard():
+    s = lead(mk())
+    commit_tail(s)
+    # lagging peer: a pipelined-to but UNACKED peer must not pass the
+    # gate (confirmed match_index is what counts, not next_index)
+    s.cluster[S2].match_index = 0
+    s.cluster[S2].next_index = s.log.next_index()
+    effects = s.handle(("transfer_leadership", S2, object()))
+    assert replies_of(effects) == [("error", "not_up_to_date")]
+    s.cluster[S2].match_index = s.log.last_index_term()[0]
+    assert s.role == LEADER
+    commit_tail(s)
+    # nonvoter target
+    s.cluster[S3].voter_status = ("nonvoter", 99)
+    effects = s.handle(("transfer_leadership", S3, object()))
+    assert replies_of(effects) == [("error", "non_voter")]
+    assert s.role == LEADER
+
+
+def test_transfer_leadership_holds_then_returns_to_leader():
+    """A transfer that never completes falls back to leading, retaining
+    the noop gate and appending NO new noop (reference:
+    leader_enters_from_await_condition)."""
+    s = lead(mk())
+    commit_tail(s)
+    assert s.cluster_change_permitted
+    nxt = s.log.next_index()
+    effects = s.handle(("transfer_leadership", S2, object()))
+    assert replies_of(effects) == [("ok", None)]
+    assert s.role == AWAIT_CONDITION
+    from ra_tpu.server import TimeoutNow
+
+    assert sent(effects, TimeoutNow)
+    s.handle(ConditionTimeout())
+    assert s.role == LEADER
+    assert s.cluster_change_permitted  # retained across the hold
+    assert s.log.next_index() == nxt  # no fresh-election noop
+
+
+def test_transfer_leadership_steps_down_on_higher_term_aer():
+    s = lead(mk())
+    commit_tail(s)
+    s.handle(("transfer_leadership", S2, None))
+    assert s.role == AWAIT_CONDITION
+    handle_all(s, aer(term=s.current_term + 1, leader=S2,
+                      prev=s.log.last_index_term()[0],
+                      prev_term=s.log.last_index_term()[1]),
+               from_peer=S2)
+    assert s.role == FOLLOWER
+    assert s.leader_id == S2
+
+
+# ---------------------------------------------------------------------------
+# remaining scenario-group stragglers (reference:
+# pre_vote_receives_pre_vote, leader_replies_to_append_entries_rpc_with_
+# lower_term, append_entries_reply_no_success, leader_received_install_
+# snapshot_result_and_promotes_voter)
+
+
+def test_pre_vote_receives_pre_vote():
+    s = mk()
+    s.handle(ElectionTimeout())
+    assert s.role == PRE_VOTE
+    effects = s.handle(
+        PreVoteRpc(term=s.current_term, token=7, candidate_id=S2, version=1,
+                   machine_version=0, last_log_index=9, last_log_term=1),
+        from_peer=S2,
+    )
+    replies = sent(effects, PreVoteResult)
+    # grants (their log is up to date) WITHOUT leaving its own pre-vote
+    assert replies and replies[-1].vote_granted
+    assert s.role == PRE_VOTE
+
+
+def test_leader_replies_to_aer_with_lower_term():
+    s = lead(mk())
+    s.current_term += 1  # pretend a later election we won
+    effects = s.handle(aer(term=0, leader=S2), from_peer=S2)
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and not replies[-1].success
+    assert replies[-1].term == s.current_term
+    assert s.role == LEADER
+
+
+def test_leader_aer_reply_no_success_rewinds_next_index():
+    s = lead(mk())
+    commit_tail(s)
+    for v in (1, 2, 3):
+        s.handle(Command(USR, v))
+    li = s.log.last_index_term()[0]
+    assert s.cluster[S2].next_index == li + 1  # pipelined optimistically
+    effects = s.handle(
+        AppendEntriesReply(s.current_term, False, next_index=2,
+                           last_index=1, last_term=1),
+        from_peer=S2,
+    )
+    # the rewound next_index drives an immediate resend from the hint
+    # (the pipeline then advances next_index optimistically again)
+    resent = sent(effects, AppendEntriesRpc)
+    assert resent and resent[-1].prev_log_index == 1
+    assert resent[-1].entries[0].index == 2
+    assert resent[-1].entries[-1].index == li
+
+
+def test_leader_install_snapshot_result_promotes_nonvoter():
+    from ra_tpu.protocol import RA_JOIN, InstallSnapshotResult
+
+    s = lead(mk())
+    commit_tail(s)
+    s.handle(Command(kind=RA_JOIN, data=(S4, False)))
+    assert s.cluster[S4].voter_status[0] == "nonvoter"
+    target = s.cluster[S4].voter_status[1]
+    commit_tail(s)  # commit the join; changes permitted again
+    assert s.cluster_change_permitted
+    s.cluster[S4].status = "sending_snapshot"
+    handle_all(
+        s,
+        InstallSnapshotResult(term=s.current_term, last_index=target + 1,
+                              last_term=1),
+        from_peer=S4,
+    )
+    # the promotion cluster change was appended and adopted leader-side
+    assert s.cluster[S4].voter_status == "voter"
+
+
+def test_follower_cluster_change_overwrite_updates_membership():
+    """A cluster change adopted at write time from a deposed leader must
+    roll back when a new leader overwrites that suffix (reference:
+    follower_cluster_change_overwrite_updates_membership)."""
+    from ra_tpu.protocol import RA_JOIN
+
+    s = mk()
+    handle_all(s, aer(entries=[ent(1, 1, 1)]), from_peer=S2)
+    join = Entry(2, 1, Command(kind=RA_JOIN, data=(S4, True)))
+    handle_all(s, aer(prev=1, prev_term=1, entries=[join]), from_peer=S2)
+    assert S4 in s.cluster  # adopted at write time, before commit
+    # a new leader overwrites index 2 with a plain entry
+    handle_all(
+        s,
+        aer(term=2, leader=S3, prev=1, prev_term=1,
+            entries=[Entry(2, 2, Command(USR, 9))]),
+        from_peer=S3,
+    )
+    assert S4 not in s.cluster  # the un-committed join rolled back
+    assert set(s.cluster) == set(IDS)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-sender backoff family (reference:
+# snapshot_sender_exponential_backoff, snapshot_backoff_prevents_
+# immediate_retry, snapshot_backoff_reset_on_nodeup,
+# snapshot_sender_down_triggers_pending_release_cursor)
+
+
+def retry_timers(effects):
+    from ra_tpu.effects import StartSnapshotRetryTimer
+
+    return [e for e in effects if isinstance(e, StartSnapshotRetryTimer)]
+
+
+def test_snapshot_sender_exponential_backoff():
+    s = lead(mk())
+    commit_tail(s)
+    s.cluster[S2].status = ("sending_snapshot", 0)
+    effects = s.handle(("snapshot_sender_down", S2, "failed"))
+    assert s.cluster[S2].status == ("snapshot_backoff", 1)
+    assert [t.delay_ms for t in retry_timers(effects)] == [5000]
+    s.cluster[S2].status = ("sending_snapshot", 1)
+    effects = s.handle(("snapshot_sender_down", S2, "failed"))
+    assert s.cluster[S2].status == ("snapshot_backoff", 2)
+    assert [t.delay_ms for t in retry_timers(effects)] == [10000]
+    s.cluster[S2].status = ("sending_snapshot", 2)
+    effects = s.handle(("snapshot_sender_down", S2, "failed"))
+    assert s.cluster[S2].status == ("snapshot_backoff", 3)
+    assert [t.delay_ms for t in retry_timers(effects)] == [20000]
+    # the delay is capped at 60 s
+    s.cluster[S2].status = ("sending_snapshot", 9)
+    effects = s.handle(("snapshot_sender_down", S2, "failed"))
+    assert [t.delay_ms for t in retry_timers(effects)] == [60000]
+    # a NORMAL sender exit resets to normal, no timer
+    s.cluster[S2].status = ("sending_snapshot", 3)
+    effects = s.handle(("snapshot_sender_down", S2, "normal"))
+    assert s.cluster[S2].status == "normal"
+    assert retry_timers(effects) == []
+
+
+def test_snapshot_backoff_prevents_immediate_retry():
+    s = lead(mk())
+    commit_tail(s)
+    s.log.update_release_cursor(1, tuple(IDS), 0, s.machine_state)
+    assert s.log.snapshot_index_term() is not None
+    s.cluster[S2].status = ("snapshot_backoff", 2)
+    s.cluster[S2].next_index = 1
+    # the pipeline must not touch a backing-off peer
+    effects = []
+    s._pipeline(effects)
+    assert not [e for e in effects if isinstance(e, SendSnapshot) and e.to == S2]
+    assert not [
+        e for e in effects
+        if isinstance(e, SendRpc) and e.to == S2
+        and isinstance(e.msg, AppendEntriesRpc)
+    ]
+    # the retry timeout re-sends, KEEPING the status (the send-effect
+    # handler reads the attempt count from it)
+    effects = s.handle(("snapshot_retry_timeout", S2))
+    assert [e for e in effects if isinstance(e, SendSnapshot) and e.to == S2]
+    assert s.cluster[S2].status == ("snapshot_backoff", 2)
+    # retry timeouts for normal or unknown peers are ignored
+    s.cluster[S2].status = "normal"
+    assert s.handle(("snapshot_retry_timeout", S2)) == []
+    assert s.handle(("snapshot_retry_timeout", SX)) == []
+
+
+def test_snapshot_backoff_reset_on_nodeup():
+    from ra_tpu.protocol import NodeEvent
+
+    s = lead(mk())
+    commit_tail(s)
+    s.cluster[S2].status = ("snapshot_backoff", 3)
+    s.handle(NodeEvent(S2[1], "up"))
+    assert s.cluster[S2].status == "normal"
+    # disconnected resets the same way
+    s.cluster[S3].status = "disconnected"
+    s.handle(NodeEvent(S3[1], "up"))
+    assert s.cluster[S3].status == "normal"
+
+
+class _CondReleaseMachine(Machine):
+    """Counter machine whose applies release the cursor behind a
+    no_snapshot_sends condition."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        from ra_tpu.effects import ReleaseCursor
+
+        state += cmd
+        return state, state, [
+            ReleaseCursor(meta["index"], state,
+                          conditions=("no_snapshot_sends",))
+        ]
+
+
+def test_snapshot_sender_down_triggers_pending_release_cursor():
+    s = lead(mk(machine=_CondReleaseMachine()))
+    commit_tail(s)  # commits the noop
+    s.cluster[S2].status = ("sending_snapshot", 1)
+    s.handle(Command(USR, 5))
+    commit_tail(s)  # applies -> cursor stashed behind the send
+    assert s.pending_release_cursor is not None
+    assert s.log.snapshot_index_term() is None
+    # sender finishes normally: the stashed cursor fires
+    s.handle(("snapshot_sender_down", S2, "normal"))
+    assert s.pending_release_cursor is None
+    assert s.log.snapshot_index_term() is not None
+
+
+class _WrittenCondMachine(Machine):
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        from ra_tpu.effects import ReleaseCursor
+
+        state += cmd
+        return state, state, [
+            ReleaseCursor(meta["index"], state,
+                          conditions=(("written", meta["index"]),))
+        ]
+
+
+def test_update_release_cursor_with_written_condition():
+    """The cursor may not truncate entries the WAL has not made durable
+    yet (reference: update_release_cursor_with_written_condition)."""
+    s = mk(machine=_WrittenCondMachine(), auto_written=False)
+    handle_all(s, aer(commit=2, entries=[ent(1, 1, 3), ent(2, 1, 4)]),
+               from_peer=S2)
+    # applied (commit=2) but nothing written yet: stashed
+    assert s.last_applied == 2
+    assert s.pending_release_cursor is not None
+    assert s.log.snapshot_index_term() is None
+    # the WAL-written event releases it
+    wi, _ = s.log.last_index_term()
+    for evt in s.log.pending_written_events():
+        handle_all(s, LogEvent(evt))
+    assert s.log.last_written()[0] == wi
+    assert s.pending_release_cursor is None
+    assert s.log.snapshot_index_term() is not None

@@ -155,12 +155,21 @@ def test_follower_aer_scenario_3_gap_rejected_then_backfilled():
     )
     r = aer_replies(effects)[-1]
     assert not r.success and r.next_index == 2 and r.last_index == 1
-    # backfill [2,3,4] with commit 3
-    s.handle(
+    # the reject also enters the catch-up hold: further too-far AERs
+    # must not trigger one rewind each while the resend is in flight
+    # (reference: follower_catchup_condition)
+    assert s.role == "await_condition"
+    assert aer_replies(s.handle(
+        aer(prev=5, prev_term=1, commit=3, entries=[ent(6, 1, 6)]), from_peer=S1
+    )) == []
+    # backfill [2,3,4] with commit 3 releases the hold (re-injected)
+    handle_all(
+        s,
         aer(prev=1, prev_term=1, commit=3,
             entries=[ent(2, 1, 2), ent(3, 1, 3), ent(4, 1, 4)]),
         from_peer=S1,
     )
+    assert s.role == "follower"
     assert (s.commit_index, s.last_applied) == (3, 3)
     replies = aer_replies(drain_written(s))
     assert replies[-1].success and replies[-1].last_index == 4

@@ -606,7 +606,7 @@ def test_leader_sends_snapshot_when_peer_behind_compaction():
     effects = []
     s1._pipeline(effects)
     assert any(isinstance(e, SendSnapshot) and e.to == S2 for e in effects)
-    assert s1.cluster[S2].status == "sending_snapshot"
+    assert s1.cluster[S2].status == ("sending_snapshot", 0)
 
 
 # ---------------------------------------------------------------------------

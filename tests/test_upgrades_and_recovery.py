@@ -373,3 +373,59 @@ def test_external_read_plan_and_low_priority_and_sync_pool(tmp_path):
     assert node.sync_pool is not None
     api.stop_node("rpA")
     leaderboard.clear()
+
+
+class _AuxProbeMachine(Machine):
+    """Counter machine with an aux side-table, for proving aux state is
+    REINITIALIZED (not resurrected) across a checkpointed recovery."""
+
+    def init(self, config):
+        return 0
+
+    def apply(self, meta, cmd, state):
+        return state + cmd, state + cmd
+
+    def init_aux(self, name):
+        return {"name": name, "v": "fresh"}
+
+    def handle_aux(self, role, kind, cmd, aux_state, intern):
+        if isinstance(cmd, tuple) and cmd and cmd[0] == "set":
+            return "ok", dict(aux_state, v=cmd[1])
+        return aux_state.get("v"), aux_state
+
+
+def _aux_probe_factory(config):
+    return _AuxProbeMachine()
+
+
+def test_recovery_checkpoint_reinitialises_aux_state(tmp_path):
+    """Aux state is ephemeral: recovering from a recovery checkpoint
+    restores the MACHINE state but re-runs init_aux (reference:
+    recovery_checkpoint_reinitialises_aux_state,
+    test/ra_server_SUITE.erl)."""
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    cfg = SystemConfig(name="rax", data_dir=str(tmp_path))
+    api.start_node("raxA", cfg, election_timeout_s=0.1, tick_interval_s=0.05)
+    node = registry().get("raxA")
+    sid = ("x1", "raxA")
+    node.start_server(
+        "x1", "raxc", None, (sid,),
+        machine_factory="test_upgrades_and_recovery:_aux_probe_factory",
+    )
+    api.trigger_election(sid)
+    for _ in range(3):
+        r, _ = api.process_command(sid, 1, timeout=10)
+    assert r == 3
+    assert api.aux_command(sid, ("set", "dirty"))[1] == "ok"
+    assert api.aux_command(sid, ("get",))[1] == "dirty"
+    node.stop_server("x1")  # orderly: writes the recovery checkpoint
+    node.restart_server("x1")
+    srv = node.procs["x1"].server
+    assert srv.machine_state == 3  # machine state recovered...
+    assert srv.counter.to_dict()["recovery_checkpoint_used"] == 1
+    api.trigger_election(sid)
+    assert api.aux_command(sid, ("get",))[1] == "fresh"  # ...aux was not
+    api.stop_node("raxA")
+    leaderboard.clear()
