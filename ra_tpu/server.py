@@ -265,6 +265,7 @@ class Server:
 
         self.condition: Optional[Condition] = None
         self.condition_generation = 0  # stale-ConditionTimeout guard
+        self._held_from_leader = False  # hold entered from leadership
         # a release cursor stashed behind unmet conditions:
         # (index, machine_state, conditions) — re-evaluated on written
         # events, AER acks, and snapshot-sender exits (reference:
@@ -487,11 +488,29 @@ class Server:
         if role == FOLLOWER:
             self.votes = set()
             self.pre_votes = set()
-        if prev == LEADER and role != LEADER:
-            # stepping down: outstanding client replies will never be
-            # issued by us — drop the handles so callers time out/retry
+        if prev == LEADER and role == AWAIT_CONDITION:
+            # a leader's hold (transfer / wal_down) may RESUME
+            # leadership: replies for commands that still commit are
+            # retained until the hold resolves to a real step-down
+            self._held_from_leader = True
+        stepping_down = (prev == LEADER and role not in (LEADER, AWAIT_CONDITION)) or (
+            prev == AWAIT_CONDITION
+            and role != LEADER
+            and getattr(self, "_held_from_leader", False)
+        )
+        if role == LEADER or stepping_down:
+            self._held_from_leader = False
+        if stepping_down:
+            # stepping down for real: outstanding client replies will
+            # never be issued by us — drop the handles so callers time
+            # out/retry, and clear snapshot-transfer statuses so a
+            # later election does not find peers stranded in
+            # sending/backoff with no sender or timer behind them
             self.pending_replies.clear()
             self.pending_queries = []
+            for p in self.cluster.values():
+                if status_kind(p.status) in ("sending_snapshot", "snapshot_backoff"):
+                    p.status = "normal"
         if prev != role:
             effects.append(StateEnter(role))
             effects.extend(self.machine.state_enter(role, self.machine_state))
@@ -584,6 +603,14 @@ class Server:
             effects.append(SendRpc(from_peer, RequestVoteResult(self.current_term, False)))
             return effects
         if isinstance(msg, PreVoteRpc):
+            # a backing-off peer that starts pre-voting is alive and
+            # still behind: re-engage it with the snapshot immediately
+            # instead of waiting out the retry backoff (reference:
+            # leader_pre_vote_sends_snapshot_to_backoff_peer)
+            peer = self.cluster.get(msg.candidate_id)
+            if peer is not None and status_kind(peer.status) == "snapshot_backoff":
+                effects.append(SendSnapshot(msg.candidate_id,
+                                            meta=self.log.snapshot_meta()))
             return self._process_pre_vote(msg, from_peer, effects)
         if isinstance(msg, AppendEntriesRpc):
             if msg.term > self.current_term:
@@ -921,7 +948,15 @@ class Server:
         if isinstance(msg, NodeEvent):
             for sid, p in self.peers().items():
                 if sid[1] == msg.node:
-                    p.status = "disconnected" if msg.status == "down" else "normal"
+                    if msg.status == "down":
+                        p.status = "disconnected"
+                    elif status_kind(p.status) != "sending_snapshot":
+                        # nodeup resets disconnected/backoff (reference:
+                        # snapshot_backoff_reset_on_nodeup) but must NOT
+                        # clobber a LIVE transfer — that would let a
+                        # no_snapshot_sends cursor fire mid-send and
+                        # wipe the backoff ladder
+                        p.status = "normal"
             data = ("nodeup", msg.node) if msg.status == "up" else ("nodedown", msg.node)
             self._append_leader(Command(kind=USR, data=data), effects)
         else:  # DownEvent
@@ -1849,6 +1884,33 @@ class Server:
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)
             self._maybe_emit_pending_release_cursor()  # ("written", idx)
+            return effects
+        if isinstance(msg, InstallSnapshotResult):
+            # a transfer that COMPLETES during a hold: record the
+            # peer's progress so a resumed leader pipelines from the
+            # snapshot index instead of finding a stranded status
+            peer = self.cluster.get(from_peer)
+            if peer is not None:
+                peer.status = "normal"
+                peer.match_index = max(peer.match_index, msg.last_index)
+                peer.next_index = max(peer.next_index, msg.last_index + 1)
+                self._maybe_emit_pending_release_cursor()
+            return effects
+        if isinstance(msg, tuple) and msg and msg[0] == "snapshot_sender_down":
+            # a transfer that dies during a hold must not strand the
+            # peer in sending status: reset so a resumed leader's
+            # pipeline re-engages (no retry timer while held)
+            peer = self.cluster.get(msg[1])
+            if peer is not None and status_kind(peer.status) in (
+                "sending_snapshot", "snapshot_backoff",
+            ):
+                peer.status = "normal"
+                self._maybe_emit_pending_release_cursor()
+            return effects
+        if isinstance(msg, tuple) and msg and msg[0] == "snapshot_retry_timeout":
+            peer = self.cluster.get(msg[1])
+            if peer is not None and status_kind(peer.status) == "snapshot_backoff":
+                peer.status = "normal"  # resumed leaders re-send directly
             return effects
         if isinstance(msg, Command) and msg.from_ref is not None:
             # never strand a caller while held: redirect so the client

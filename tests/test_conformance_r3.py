@@ -1129,3 +1129,103 @@ def test_update_release_cursor_with_written_condition():
     assert s.log.last_written()[0] == wi
     assert s.pending_release_cursor is None
     assert s.log.snapshot_index_term() is not None
+
+
+def test_leader_pre_vote_sends_snapshot_to_backoff_peer():
+    """A backing-off peer that starts a pre-vote is alive again: the
+    leader re-engages it with the snapshot instead of waiting out the
+    retry delay (reference: leader_pre_vote_sends_snapshot_to_backoff_
+    peer)."""
+    s = lead(mk())
+    commit_tail(s)
+    s.log.update_release_cursor(1, tuple(IDS), 0, s.machine_state)
+    s.cluster[S2].status = ("snapshot_backoff", 2)
+    effects = s.handle(
+        PreVoteRpc(term=s.current_term, token=3, candidate_id=S2, version=1,
+                   machine_version=0, last_log_index=0, last_log_term=0),
+        from_peer=S2,
+    )
+    assert [e for e in effects if isinstance(e, SendSnapshot) and e.to == S2]
+    assert s.role == LEADER  # not dethroned by the probe
+
+
+def test_leader_noop_operation_enables_cluster_change():
+    """Membership changes are gated until the new term's noop commits
+    (reference: leader_noop_operation_enables_cluster_change)."""
+    from ra_tpu.protocol import RA_JOIN
+
+    s = lead(mk())
+    assert not s.cluster_change_permitted
+    effects = s.handle(Command(kind=RA_JOIN, data=(S4, True), from_ref=object()))
+    assert replies_of(effects) == [("error", "cluster_change_not_permitted")]
+    assert S4 not in s.cluster
+    commit_tail(s)  # noop commits
+    assert s.cluster_change_permitted
+    s.handle(Command(kind=RA_JOIN, data=(S4, True)))
+    assert S4 in s.cluster
+
+
+# ---------------------------------------------------------------------------
+# snapshot-status lifecycle across holds, node flaps, and step-down
+
+
+def test_transfer_hold_retains_pending_replies_on_resume():
+    """A hold that RESUMES leadership must still issue replies for
+    commands that commit afterwards — only a real step-down drops
+    them."""
+    s = lead(mk())
+    commit_tail(s)
+    fut = object()
+    s.handle(Command(kind=USR, data=5, reply_mode="await_consensus",
+                     from_ref=fut))
+    li = s.log.last_index_term()[0]
+    s.cluster[S2].match_index = li
+    s.cluster[S2].next_index = li + 1
+    s.handle(("transfer_leadership", S2, None))
+    assert s.role == AWAIT_CONDITION and s.pending_replies
+    s.handle(ConditionTimeout())
+    assert s.role == LEADER and s.pending_replies
+    effects = commit_tail(s)
+    assert [e for e in effects if isinstance(e, Reply) and e.from_ref is fut]
+
+
+def test_sender_down_during_hold_resets_peer_status():
+    """A sender dying while the leader holds must not strand the peer
+    in sending status past the hold."""
+    s = lead(mk())
+    commit_tail(s)
+    li = s.log.last_index_term()[0]
+    s.cluster[S2].match_index = li
+    s.cluster[S2].next_index = li + 1
+    s.cluster[S3].status = ("sending_snapshot", 1)
+    s.handle(("transfer_leadership", S2, None))
+    assert s.role == AWAIT_CONDITION
+    s.handle(("snapshot_sender_down", S3, "failed"))
+    assert s.cluster[S3].status == "normal"
+    s.handle(ConditionTimeout())
+    assert s.role == LEADER  # pipeline will re-engage S3 directly
+
+
+def test_nodeup_does_not_clobber_live_transfer():
+    from ra_tpu.protocol import NodeEvent
+
+    s = lead(mk())
+    commit_tail(s)
+    s.cluster[S2].status = ("sending_snapshot", 2)
+    s.handle(NodeEvent(S2[1], "up"))
+    assert s.cluster[S2].status == ("sending_snapshot", 2)
+
+
+def test_step_down_normalizes_snapshot_statuses():
+    """Deposed leaders must not leave peers in sending/backoff — a
+    stale status would stash no_snapshot_sends cursors forever."""
+    s = lead(mk())
+    commit_tail(s)
+    s.cluster[S2].status = ("sending_snapshot", 1)
+    s.cluster[S3].status = ("snapshot_backoff", 2)
+    li, lt = s.log.last_index_term()
+    handle_all(s, aer(term=s.current_term + 1, leader=S3, prev=li,
+                      prev_term=lt), from_peer=S3)
+    assert s.role == FOLLOWER
+    assert s.cluster[S2].status == "normal"
+    assert s.cluster[S3].status == "normal"

@@ -1,0 +1,174 @@
+"""Replicated-log determinism property (reference: ra_props_SUITE —
+random NON-associative op sequences against a live 3-member cluster;
+every replica's folded state must equal the reference fold of the
+committed log, test/ra_props_SUITE.erl:53-70).
+
+Non-associative/non-commutative ops (sub, rdiv, append) make any
+reordering, duplication, or loss between replicas visible in the final
+state — a commuting workload could mask them.
+"""
+
+import random
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import Machine
+from ra_tpu.protocol import Command, USR
+from ra_tpu.system import SystemConfig
+
+
+def fold_op(state, op):
+    kind, x = op
+    if kind == "add":
+        return (state * 31 + x) % 1_000_003  # order-sensitive mix
+    if kind == "sub":
+        return (state - x) % 1_000_003
+    return (state ^ (x + state)) % 1_000_003  # "mix": depends on state
+
+
+class OpMachine(Machine):
+    def init(self, config):
+        return 7
+
+    def apply(self, meta, cmd, state):
+        if isinstance(cmd, tuple) and cmd and cmd[0] in (
+            "down", "nodeup", "nodedown", "machine_version", "timeout",
+        ):
+            return state, None
+        s = fold_op(state, cmd)
+        return s, s
+
+
+def rand_op(rng):
+    return (rng.choice(["add", "sub", "mix"]), rng.randrange(1, 1000))
+
+
+NODES = ["prA", "prB", "prC"]
+
+
+@pytest.mark.parametrize("seed", [5, 17])
+def test_replica_fold_equals_reference_fold(tmp_path, seed):
+    """Issue random non-associative ops (pipelined, at-most-once), then
+    assert: (a) all replicas converge to identical machine state, and
+    (b) that state equals folding the committed log's USR payloads in
+    log order — replicated-log determinism."""
+    from ra_tpu.runtime.transport import registry
+
+    rng = random.Random(seed)
+    leaderboard.clear()
+    for n in NODES:
+        api.start_node(
+            n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+            election_timeout_s=0.1, tick_interval_s=0.1, detector_poll_s=0.05,
+        )
+    members = [("p", n) for n in NODES]
+    try:
+        api.start_cluster("prc", OpMachine, members)
+        leader = api.members(members[0], timeout=10)[1]
+        n_ops = 60
+        for i in range(n_ops):
+            op = rand_op(rng)
+            r = None
+            for _ in range(3):
+                try:
+                    r, leader = api.process_command(leader, op, timeout=5)
+                    break
+                except api.RaError:
+                    leader = api.members(members[0], timeout=5)[1]
+            assert r is not None
+        # quiesce: all replicas applied everything the leader committed
+        lead_srv = registry().get(leader[1]).procs[leader[0]].server
+        commit = lead_srv.commit_index
+        servers = [registry().get(n).procs["p"].server for n in NODES]
+        deadline = time.time() + 15
+        while time.time() < deadline and not all(
+            s.last_applied >= commit for s in servers
+        ):
+            time.sleep(0.05)
+        states = [s.machine_state for s in servers]
+        assert len(set(states)) == 1, states
+        # reference fold over the committed log (USR payloads in order)
+        acc = 7
+        entries = lead_srv.log.fetch_range(1, commit)
+        for e in entries:
+            if isinstance(e.cmd, Command) and e.cmd.kind == USR:
+                data = e.cmd.data
+                if isinstance(data, tuple) and data and data[0] in (
+                    "add", "sub", "mix",
+                ):
+                    acc = fold_op(acc, data)
+        assert states[0] == acc, (states[0], acc)
+    finally:
+        for n in NODES:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
+
+
+def test_replica_fold_holds_across_leader_kill(tmp_path):
+    """The determinism property must survive a mid-stream failover: ops
+    issued around a leader kill still leave every surviving replica at
+    the reference fold of whatever actually committed."""
+    from ra_tpu.runtime.transport import registry
+
+    rng = random.Random(99)
+    leaderboard.clear()
+    for n in NODES:
+        api.start_node(
+            n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+            election_timeout_s=0.1, tick_interval_s=0.1, detector_poll_s=0.05,
+        )
+    members = [("p", n) for n in NODES]
+    try:
+        api.start_cluster("prk", OpMachine, members)
+        leader = api.members(members[0], timeout=10)[1]
+        for _ in range(20):
+            r, leader = api.process_command(leader, rand_op(rng), timeout=5)
+        api.stop_server(leader)
+        survivors = [m for m in members if m != leader]
+        deadline = time.time() + 15
+        new_leader = None
+        while time.time() < deadline:
+            try:
+                cand = api.members(survivors[0], timeout=2)[1]
+                if cand and cand != leader:
+                    new_leader = cand
+                    break
+            except api.RaError:
+                pass
+            time.sleep(0.1)
+        assert new_leader is not None
+        for _ in range(20):
+            r, new_leader = api.process_command(
+                new_leader, rand_op(rng), timeout=5, retry_on_timeout=True
+            )
+        lead_srv = registry().get(new_leader[1]).procs["p"].server
+        commit = lead_srv.commit_index
+        servers = [registry().get(m[1]).procs["p"].server for m in survivors]
+        deadline = time.time() + 15
+        while time.time() < deadline and not all(
+            s.last_applied >= commit for s in servers
+        ):
+            time.sleep(0.05)
+        states = [s.machine_state for s in servers]
+        assert len(set(states)) == 1, states
+        acc = 7
+        for e in lead_srv.log.fetch_range(1, commit):
+            if isinstance(e.cmd, Command) and e.cmd.kind == USR:
+                data = e.cmd.data
+                if isinstance(data, tuple) and data and data[0] in (
+                    "add", "sub", "mix",
+                ):
+                    acc = fold_op(acc, data)
+        assert states[0] == acc
+    finally:
+        for n in NODES:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
