@@ -38,6 +38,8 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
     ("snapshot_index", "gauge", "current snapshot index"),
     ("snapshots_written", "counter", "snapshots written"),
     ("snapshot_installed", "counter", "snapshots installed (follower)"),
+    ("snapshot_send_failures", "counter",
+     "snapshot sender deaths (backoff retries armed)"),
     ("checkpoints_written", "counter", "checkpoints written"),
     ("recovery_checkpoint_used", "counter", "boots that skipped replay"),
     ("checkpoints_promoted", "counter", "checkpoints promoted to snapshots"),

@@ -242,6 +242,13 @@ class ElectionTimeout:
 
 
 @dataclasses.dataclass(frozen=True)
+class TimeoutNow:
+    """Leadership-transfer trigger: the target starts an election
+    immediately, skipping pre-vote (Raft §3.10). Sent leader->target
+    over the wire, so it lives with the protocol records."""
+
+
+@dataclasses.dataclass(frozen=True)
 class Tick:
     now_ms: int = 0
 

@@ -63,6 +63,7 @@ from ra_tpu.protocol import (
     CHUNK_PRE,
     Command,
     ElectionTimeout,
+    TimeoutNow,
     Entry,
     FromPeer,
     HeartbeatReply,
@@ -1690,6 +1691,45 @@ class BatchCoordinator:
         if isinstance(msg, tuple) and msg and msg[0] == "local_query":
             _, fn, fut = msg
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
+            return
+        if isinstance(msg, TimeoutNow):
+            # leadership-transfer trigger from any backend's leader: the
+            # target runs an election round immediately. The batch
+            # election path goes through the shared pre-vote machinery
+            # (the old leader answers probes in place, so the round is
+            # never disrupted by its liveness).
+            if g.role != C.R_LEADER and g.voter_status.get(g.self_slot) == "voter":
+                self._handle_rare(g, ElectionTimeout(), None)
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "transfer_leadership":
+            _, target, fut = msg
+            me = (g.name, self.name)
+            if g.role != C.R_LEADER:
+                self._reply(fut, ("redirect", g.sid_of(g.leader_slot)))
+                return
+            target = tuple(target)
+            if target == me:
+                self._reply(fut, ("ok", "already_leader"))
+                return
+            slot = g.slot_of(target)
+            if slot < 0:
+                self._reply(fut, ("error", "unknown_member"))
+                return
+            if g.voter_status.get(slot) != "voter":
+                self._reply(fut, ("error", "non_voter"))
+                return
+            li, _ = g.log.last_index_term()
+            # gate on the device's CONFIRMED match for the slot — the
+            # host next_index advances optimistically at send time, so
+            # a pipelined-to-but-unacked peer must not pass (mirrors
+            # the scalar backend's match_index gate). One device read;
+            # transfers are rare.
+            confirmed = int(np.asarray(self.state.match_index)[g.gid, slot])
+            if confirmed != li:
+                self._reply(fut, ("error", "not_up_to_date"))
+                return
+            self._reply(fut, ("ok", None))
+            self._send_batch(target[1], [(target, TimeoutNow(), me)])
             return
         if isinstance(msg, tuple) and msg and msg[0] == "resync":
             if g.role == C.R_LEADER:

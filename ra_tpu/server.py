@@ -128,10 +128,9 @@ class PeerState:
         return self.voter_status == "voter"
 
 
-@dataclasses.dataclass
-class TimeoutNow:
-    """Leadership-transfer trigger: target starts an election
-    immediately, skipping pre-vote (Raft §3.10)."""
+# re-exported for existing importers; the class lives with the wire
+# protocol records now (sent leader->target over transport)
+from ra_tpu.protocol import TimeoutNow  # noqa: E402,F401
 
 
 @dataclasses.dataclass
@@ -948,15 +947,16 @@ class Server:
         if isinstance(msg, NodeEvent):
             for sid, p in self.peers().items():
                 if sid[1] == msg.node:
-                    if msg.status == "down":
-                        p.status = "disconnected"
-                    elif status_kind(p.status) != "sending_snapshot":
-                        # nodeup resets disconnected/backoff (reference:
-                        # snapshot_backoff_reset_on_nodeup) but must NOT
-                        # clobber a LIVE transfer — that would let a
-                        # no_snapshot_sends cursor fire mid-send and
-                        # wipe the backoff ladder
-                        p.status = "normal"
+                    # neither direction may clobber a LIVE transfer —
+                    # that would let a no_snapshot_sends cursor fire
+                    # mid-send and lose the attempt count (the sender's
+                    # own death routes through snapshot_sender_down,
+                    # which arms the backoff); nodeup resets
+                    # disconnected/backoff (reference:
+                    # snapshot_backoff_reset_on_nodeup)
+                    if status_kind(p.status) == "sending_snapshot":
+                        continue
+                    p.status = "disconnected" if msg.status == "down" else "normal"
             data = ("nodeup", msg.node) if msg.status == "up" else ("nodedown", msg.node)
             self._append_leader(Command(kind=USR, data=data), effects)
         else:  # DownEvent
@@ -1886,6 +1886,14 @@ class Server:
             self._maybe_emit_pending_release_cursor()  # ("written", idx)
             return effects
         if isinstance(msg, InstallSnapshotResult):
+            if msg.term > self.current_term:
+                # stale-term rejection: the cluster moved on while we
+                # held — step down now rather than resuming a stale
+                # leadership on the condition timeout
+                self._update_term(msg.term)
+                self.condition = None
+                self._become_follower(effects)
+                return effects
             # a transfer that COMPLETES during a hold: record the
             # peer's progress so a resumed leader pipelines from the
             # snapshot index instead of finding a stranded status

@@ -323,3 +323,47 @@ def test_batch_aux_machine_and_kv_model():
         assert out[1]["applied"] >= 2
     finally:
         stop_all(coords)
+
+
+def test_batch_transfer_leadership():
+    """Leadership transfer on the batch backend (parity with
+    ra:transfer_leadership): gate checks, hand-off via TimeoutNow, and
+    continued service under the new leader."""
+    coords = mk_cluster("tl")
+    try:
+        gname = "tlg0"
+        old = coords[0].by_name[gname]
+        # settle the noop so commands flow
+        fut = api.Future()
+        coords[0].deliver((gname, "tl0"),
+                          Command(kind=USR, data=1,
+                                  reply_mode="await_consensus", from_ref=fut),
+                          None)
+        assert fut.result(30)[0] == "ok"
+        # gate: unknown member
+        fut = api.Future()
+        coords[0].deliver((gname, "tl0"),
+                          ("transfer_leadership", (gname, "nope"), fut), None)
+        assert fut.result(10) == ("error", "unknown_member")
+        # transfer to a caught-up member
+        target = (gname, "tl1")
+        await_(lambda: old.next_index[old.slot_of(target)]
+               == old.log.last_index_term()[0] + 1, what="target caught up")
+        fut = api.Future()
+        coords[0].deliver((gname, "tl0"),
+                          ("transfer_leadership", target, fut), None)
+        assert fut.result(10) == ("ok", None)
+        await_(lambda: coords[1].by_name[gname].role == C.R_LEADER,
+               what="target took over")
+        await_(lambda: coords[0].by_name[gname].role != C.R_LEADER,
+               what="old leader stepped down")
+        # service continues at the new leader
+        fut = api.Future()
+        coords[1].deliver(target,
+                          Command(kind=USR, data=10,
+                                  reply_mode="await_consensus", from_ref=fut),
+                          None)
+        ok, val, _ = fut.result(30)
+        assert ok == "ok" and val == 11
+    finally:
+        stop_all(coords)

@@ -1229,3 +1229,33 @@ def test_step_down_normalizes_snapshot_statuses():
     assert s.role == FOLLOWER
     assert s.cluster[S2].status == "normal"
     assert s.cluster[S3].status == "normal"
+
+
+def test_nodedown_does_not_clobber_live_transfer():
+    from ra_tpu.protocol import NodeEvent
+
+    s = lead(mk())
+    commit_tail(s)
+    s.cluster[S2].status = ("sending_snapshot", 2)
+    s.handle(NodeEvent(S2[1], "down"))
+    assert s.cluster[S2].status == ("sending_snapshot", 2)
+    # the sender's own death still routes through the backoff path
+    s.handle(("snapshot_sender_down", S2, "failed"))
+    assert s.cluster[S2].status == ("snapshot_backoff", 3)
+
+
+def test_hold_snapshot_result_higher_term_steps_down():
+    """A stale-term rejection arriving during a transfer hold deposes
+    immediately — the node must not resume a stale leadership on the
+    condition timeout."""
+    s = lead(mk())
+    commit_tail(s)
+    li, lt = s.log.last_index_term()
+    s.cluster[S2].match_index = li
+    s.cluster[S2].next_index = li + 1
+    s.handle(("transfer_leadership", S2, None))
+    assert s.role == AWAIT_CONDITION
+    s.handle(InstallSnapshotResult(term=s.current_term + 5, last_index=li,
+                                   last_term=lt), from_peer=S3)
+    assert s.role == FOLLOWER
+    assert s.current_term >= 6

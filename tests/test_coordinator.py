@@ -490,7 +490,11 @@ def test_heartbeat_adopts_term_and_steps_down_stale_leader():
         c.deliver(ids[0], ElectionTimeout(), None)
         # (single reachable member can't win quorum; force the role via
         # the device path by checking it left follower, then feed the
-        # higher-term reply through the leader handler directly)
+        # higher-term reply through the leader handler directly).
+        # Wait for the election transition to settle FIRST — forcing the
+        # role while the step thread is still processing the timeout
+        # races and the forced LEADER can be overwritten under load.
+        await_(lambda: g.role == C.R_PRE_VOTE, what="pre-vote entered")
         g.role = C.R_LEADER
         c.deliver(ids[0], HeartbeatReply(term=11, query_index=1), ids[1])
         await_(lambda: g.role == C.R_FOLLOWER and g.term == 11,
