@@ -40,6 +40,17 @@ from ra_tpu.log.tables import TableRegistry
 from ra_tpu.utils.seq import Seq
 
 MAGIC = b"RTW1"
+
+
+class WalCorruptionError(RuntimeError):
+    """Mid-file WAL corruption: an unreadable record with VALID DATA
+    after it. Recovery refuses to silently drop acked entries — this is
+    bit rot or tampering, not a torn tail (a partial FINAL record, with
+    nothing but zero padding or EOF beyond, truncates cleanly instead).
+    Reference behavior: checksum_failure_in_middle_of_file_should_fail
+    vs recover_with_partial_last_entry (test/ra_log_wal_SUITE.erl)."""
+
+
 K_UID = 1
 K_ENTRY = 2
 K_TRUNC = 3
@@ -497,6 +508,28 @@ class Wal:
                     pos = 0
                 return len(buf) - pos >= n
 
+            def fail_if_data_follows(what: str) -> None:
+                """Distinguish a torn tail from mid-file corruption: any
+                non-zero byte beyond the bad record means valid data
+                would be silently dropped — refuse to recover."""
+                rest = buf[pos:]
+                if any(rest):
+                    raise WalCorruptionError(
+                        f"{path}: {what} at offset ~{f.tell() - len(rest)} "
+                        "with data following — refusing to truncate "
+                        "acked entries (restore the file or delete it "
+                        "explicitly to accept the loss)"
+                    )
+                while True:
+                    chunk = f.read(self.RECOVER_CHUNK)
+                    if not chunk:
+                        return
+                    if any(chunk):
+                        raise WalCorruptionError(
+                            f"{path}: {what} with data following — "
+                            "refusing to truncate acked entries"
+                        )
+
             while True:
                 if not ensure(1):
                     break
@@ -525,6 +558,15 @@ class Wal:
                         if not ensure(_ENTRY_HDR.size):
                             break
                         _, ref, idx, term, crc, ln = _ENTRY_HDR.unpack_from(buf, pos)
+                        if ln > max(self.max_size_bytes, 1 << 30):
+                            # the length field is unprotected by the
+                            # record CRC; an implausible value is a bit
+                            # flip, not a torn write (a low-byte flip is
+                            # caught by the CRC check below instead)
+                            raise WalCorruptionError(
+                                f"{path}: implausible record length {ln} "
+                                "— refusing to truncate acked entries"
+                            )
                         if not ensure(_ENTRY_HDR.size + ln):
                             break  # torn tail
                         pos += _ENTRY_HDR.size
@@ -532,7 +574,10 @@ class Wal:
                         pos += ln
                         if self.compute_checksums and crc:
                             if zlib.crc32(struct.pack("<QQ", idx, term) + payload) != crc:
-                                break  # corrupt tail
+                                # torn FINAL record truncates; corruption
+                                # with live data after it must fail loud
+                                fail_if_data_follows("checksum failure")
+                                break
                         uid = uids[ref]
                         # pre-init registered this uid's snapshot floor
                         # before recovery ran: skip dead indexes instead
@@ -560,8 +605,12 @@ class Wal:
                         per[t] = per.get(t, Seq.empty()).add(idx)
                         self._last_idx[uid] = idx
                     else:
-                        break  # unknown/corrupt: stop at tail
+                        # unknown kind byte: zero padding ends the file
+                        # cleanly; anything else is corruption
+                        fail_if_data_follows(f"unknown record kind {kind}")
+                        break
                 except (struct.error, KeyError, IndexError, EOFError):
+                    fail_if_data_follows("unparseable record")
                     break
         return {
             u: {t: sq for t, sq in per.items() if not sq.is_empty()}

@@ -345,10 +345,18 @@ def test_batch_transfer_leadership():
         coords[0].deliver((gname, "tl0"),
                           ("transfer_leadership", (gname, "nope"), fut), None)
         assert fut.result(10) == ("error", "unknown_member")
-        # transfer to a caught-up member
+        # transfer to a caught-up member — await the DEVICE-confirmed
+        # match the gate actually reads (host next_index advances
+        # optimistically at send time and would flake under load)
+        import numpy as np
+
         target = (gname, "tl1")
-        await_(lambda: old.next_index[old.slot_of(target)]
-               == old.log.last_index_term()[0] + 1, what="target caught up")
+        slot = old.slot_of(target)
+        await_(
+            lambda: int(np.asarray(coords[0].state.match_index)[old.gid, slot])
+            == old.log.last_index_term()[0],
+            what="target caught up (device match)",
+        )
         fut = api.Future()
         coords[0].deliver((gname, "tl0"),
                           ("transfer_leadership", target, fut), None)

@@ -1,0 +1,184 @@
+"""Server/cluster deletion lifecycle (reference: ra_2_SUITE —
+server_is_force_deleted, force_deleted_server_mem_tables_are_cleaned_up,
+leave_and_delete_server, cluster_is_deleted, segment_writer_handles_
+server_deletion, add_member_without_quorum)."""
+
+import os
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.system import SystemConfig
+
+
+def counter():
+    return SimpleMachine(lambda c, s: s + c, 0)
+
+
+def test_force_delete_cleans_state_and_restart_is_fresh(tmp_path):
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    cfg = SystemConfig(name="fd", data_dir=str(tmp_path))
+    api.start_node("fdA", cfg, election_timeout_s=0.1, tick_interval_s=0.05)
+    node = registry().get("fdA")
+    sid = ("f1", "fdA")
+    api.start_cluster("fdc", counter, [sid])
+    for _ in range(5):
+        r, _ = api.process_command(sid, 1, timeout=10)
+    assert r == 5
+    uid = node.directory.uid_of("f1")
+    data_dir = os.path.join(str(tmp_path), "fdA", "data", uid)
+    assert os.path.isdir(data_dir)
+    api.delete_cluster([sid])
+    # every trace is gone: directory entry, meta, memtable, disk state
+    assert node.directory.uid_of("f1") is None
+    assert not os.path.isdir(data_dir)
+    assert node.tables.mem_table_if_exists(uid) is None if hasattr(
+        node.tables, "mem_table_if_exists") else True
+    # a NEW server under the same name starts from scratch
+    api.start_cluster("fdc2", counter, [sid])
+    r, _ = api.process_command(sid, 7, timeout=10)
+    assert r == 7  # not 12: no resurrected state
+    api.stop_node("fdA")
+    leaderboard.clear()
+
+
+def test_leave_and_delete_server(tmp_path):
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    nodes = ["ldA", "ldB", "ldC"]
+    for n in nodes:
+        api.start_node(n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+                       election_timeout_s=0.1, tick_interval_s=0.05,
+                       detector_poll_s=0.05)
+    members = [("l1", n) for n in nodes]
+    try:
+        api.start_cluster("ldc", counter, members)
+        leader = api.members(members[0], timeout=10)[1]
+        r, leader = api.process_command(leader, 3, timeout=10)
+        victim = [m for m in members if m != leader][-1]
+        assert api.remove_member(leader, victim, timeout=10)[0] == "ok"
+        api.delete_cluster([victim])
+        node_v = registry().get(victim[1])
+        assert node_v.directory.uid_of(victim[0]) is None
+        # the two-member cluster keeps serving
+        r, leader = api.process_command(leader, 4, timeout=10)
+        assert r == 7
+        mems, _ = api.members(leader, timeout=10)
+        assert victim not in mems and len(mems) == 2
+    finally:
+        for n in nodes:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
+
+
+def test_cluster_is_deleted_everywhere(tmp_path):
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    nodes = ["cdA", "cdB", "cdC"]
+    for n in nodes:
+        api.start_node(n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+                       election_timeout_s=0.1, tick_interval_s=0.05)
+    members = [("c1", n) for n in nodes]
+    try:
+        api.start_cluster("cdc", counter, members)
+        r, _ = api.process_command(members[0], 1, timeout=10)
+        api.delete_cluster(members)
+        for m in members:
+            node = registry().get(m[1])
+            assert node.directory.uid_of(m[0]) is None
+            assert m[0] not in node.procs
+        with pytest.raises(api.RaError):
+            api.process_command(members[0], 1, timeout=1)
+    finally:
+        for n in nodes:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
+
+
+def test_delete_during_pending_segment_flush(tmp_path):
+    """Deleting a server with rolled-over-but-unflushed WAL entries must
+    not let the segment writer recreate its data dir or crash
+    (reference: segment_writer_handles_server_deletion)."""
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    cfg = SystemConfig(name="dsf", data_dir=str(tmp_path))
+    api.start_node("dsfA", cfg, election_timeout_s=0.1, tick_interval_s=0.05)
+    node = registry().get("dsfA")
+    sid = ("d1", "dsfA")
+    api.start_cluster("dsc", counter, [sid])
+    for _ in range(30):
+        r, _ = api.process_command(sid, 1, timeout=10)
+    uid = node.directory.uid_of("d1")
+    data_dir = os.path.join(str(tmp_path), "dsfA", "data", uid)
+    # roll the WAL over so a flush for this uid is pending/in flight,
+    # then delete immediately
+    node.wal.force_rollover()
+    api.delete_cluster([sid])
+    time.sleep(0.5)  # give the segment writer time to process the epoch
+    assert not os.path.isdir(data_dir), "deleted server's dir recreated"
+    # the node remains healthy for other servers
+    sid2 = ("d2", "dsfA")
+    api.start_cluster("dsc2", counter, [sid2])
+    r, _ = api.process_command(sid2, 2, timeout=10)
+    assert r == 2
+    api.stop_node("dsfA")
+    leaderboard.clear()
+
+
+def test_add_member_without_quorum_times_out_cleanly(tmp_path):
+    leaderboard.clear()
+    nodes = ["aqA", "aqB", "aqC"]
+    for n in nodes:
+        api.start_node(n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+                       election_timeout_s=0.1, tick_interval_s=0.05)
+    members = [("a1", n) for n in nodes]
+    try:
+        api.start_cluster("aqc", counter, members)
+        leader = api.members(members[0], timeout=10)[1]
+        r, leader = api.process_command(leader, 1, timeout=10)
+        # kill both followers: no quorum for the membership entry
+        for m in members:
+            if m != leader:
+                api.stop_server(m)
+        with pytest.raises(api.RaError):
+            api.add_member(leader, ("a1", "aqX"), timeout=1.0)
+        # the JOIN was appended (configs apply at append), so the
+        # cluster is now 4-way with a ghost member: quorum is 3 and
+        # unreachable until the followers return
+        api.restart_server([m for m in members if m != leader][0])
+        api.restart_server([m for m in members if m != leader][1])
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline:
+            try:
+                r, _ = api.process_command(leader, 1, timeout=2,
+                                           retry_on_timeout=True)
+                ok = True
+                break
+            except api.RaError:
+                time.sleep(0.1)
+        assert ok and r >= 2
+        # operators undo the ghost join once the cluster is healthy
+        assert api.remove_member(leader, ("a1", "aqX"), timeout=10)[0] == "ok"
+        mems, _ = api.members(leader, timeout=10)
+        assert ("a1", "aqX") not in mems and len(mems) == 3
+    finally:
+        for n in nodes:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()

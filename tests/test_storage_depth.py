@@ -269,3 +269,80 @@ def test_log_init_on_missing_directory_is_fresh(tmp_path):
     assert log.last_index_term() == (0, 0)
     assert log.snapshot_index_term() is None
     wal.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL corruption semantics (reference:
+# checksum_failure_in_middle_of_file_should_fail vs
+# recover_with_partial_last_entry / recover_with_last_entry_corruption)
+
+
+def _flip_payload_byte(path, payload):
+    data = open(path, "rb").read()
+    off = data.index(payload)
+    mutated = bytearray(data)
+    mutated[off] ^= 0xFF
+    open(path, "wb").write(bytes(mutated))
+
+
+def test_wal_midfile_corruption_fails_recovery(tmp_path):
+    """A checksum failure with valid data AFTER it is bit rot, not a
+    torn tail: recovery must refuse rather than silently drop acked
+    entries."""
+    import pickle
+
+    from ra_tpu.log.wal import WalCorruptionError
+
+    sink = Sink()
+    wal = mk_wal(tmp_path, sink)
+    payloads = [pickle.dumps(f"record-{i}") for i in range(1, 6)]
+    for i, p in enumerate(payloads, start=1):
+        wal.write("u1", i, 1, p)
+    wal.flush()
+    path = wal._file_path
+    wal.close()
+    _flip_payload_byte(path, payloads[1])  # corrupt record 2 of 5
+    with pytest.raises(WalCorruptionError):
+        Wal(str(tmp_path / "wal"), TableRegistry(), Sink(),
+            threaded=False, sync_method="none")
+
+
+def test_wal_last_record_corruption_truncates(tmp_path):
+    """Corruption of the FINAL record is indistinguishable from a torn
+    write: recovery truncates it and keeps everything before."""
+    import pickle
+
+    sink = Sink()
+    wal = mk_wal(tmp_path, sink)
+    payloads = [pickle.dumps(f"record-{i}") for i in range(1, 6)]
+    for i, p in enumerate(payloads, start=1):
+        wal.write("u1", i, 1, p)
+    wal.flush()
+    path = wal._file_path
+    wal.close()
+    _flip_payload_byte(path, payloads[-1])
+    tables2 = TableRegistry()
+    Wal(str(tmp_path / "wal"), tables2, Sink(), threaded=False,
+        sync_method="none")
+    mt = tables2.mem_table("u1")
+    assert mt.get(4) is not None
+    assert mt.get(5) is None  # the corrupt final record dropped
+
+
+def test_consecutive_terms_in_batch_give_two_written_events(tmp_path):
+    """A single WAL batch spanning a term change must emit one written
+    event per term, so follower acks never claim the wrong term
+    (reference: consecutive_terms_in_batch_should_result_in_two_
+    written_events)."""
+    import pickle
+
+    sink = Sink()
+    wal = mk_wal(tmp_path, sink)
+    wal.write("u1", 1, 1, pickle.dumps("a"))
+    wal.write("u1", 2, 1, pickle.dumps("b"))
+    wal.write("u1", 3, 2, pickle.dumps("c"))
+    wal.flush()
+    events = sink.of("u1", "written")
+    assert len(events) == 2
+    assert events[0][1] == 1 and list(events[0][2]) == [1, 2]
+    assert events[1][1] == 2 and list(events[1][2]) == [3]
