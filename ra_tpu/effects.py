@@ -87,6 +87,31 @@ class Demonitor(Effect):
 
 
 @dataclasses.dataclass(frozen=True)
+class Append(Effect):
+    """Machine effect: append ``cmd`` as a NEW user command to the raft
+    log — leader-only, silently dropped elsewhere (reference:
+    ``{append, Cmd}`` / ``{append, Cmd, ReplyMode}``,
+    src/ra_machine.erl:131-159, realised as a next_event command,
+    src/ra_server_proc.erl:1604-1609)."""
+
+    cmd: Any
+    reply_mode: Any = "noreply"
+    from_ref: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TryAppend(Effect):
+    """Like :class:`Append` but attempted in ANY raft state — a
+    non-leader routes it like any client command (redirect/drop)
+    (reference: ``{try_append, Cmd, ReplyMode}``,
+    src/ra_server_proc.erl:1610-1615)."""
+
+    cmd: Any
+    reply_mode: Any = "noreply"
+    from_ref: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Timer(Effect):
     """Machine timer: deliver {timeout, name} to apply after ms (None
     cancels)."""

@@ -706,6 +706,31 @@ def record_snapshot(
 
 
 @jax.jit
+def force_elections(state: GroupState, group_ids: jax.Array) -> GroupState:
+    """Leadership-transfer fast path: the named groups become candidates
+    IMMEDIATELY — term+1, vote for self, tallies cleared — skipping the
+    pre-vote round. A TimeoutNow recipient must start a real election at
+    once (Raft §3.10; reference: leadership transfer sends
+    #timeout_now{} and the recipient calls an election directly,
+    src/ra_server.erl handle_follower timeout_now). The host persists
+    the bumped term/self-vote before any vote request leaves."""
+    touched = (
+        jnp.zeros_like(state.role, dtype=jnp.bool_)
+        .at[group_ids].set(True, mode="drop")
+    )
+    return state._replace(
+        role=jnp.where(touched, R_CANDIDATE, state.role),
+        current_term=jnp.where(
+            touched, state.current_term + 1, state.current_term
+        ),
+        voted_for=jnp.where(touched, state.self_slot, state.voted_for),
+        leader_slot=jnp.where(touched, -1, state.leader_slot),
+        votes=jnp.where(touched[:, None], False, state.votes),
+        pre_votes=jnp.where(touched[:, None], False, state.pre_votes),
+    )
+
+
+@jax.jit
 def set_roles(state: GroupState, group_ids: jax.Array, roles: jax.Array) -> GroupState:
     """Host-driven role transitions (election initiation and similar rare
     paths): scatter new roles and clear election tallies for the named

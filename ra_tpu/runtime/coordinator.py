@@ -28,9 +28,12 @@ actor-backed members interoperate in one cluster. Replies leaving a step
 are batched per destination node — thousands of groups' traffic rides
 single transport hops.
 
-Round-1 scope note: snapshot install/send for batch-backed groups falls
-back to... not implemented yet — groups needing snapshot catch-up should
-run on the actor backend (documented gap, see SURVEY §7 hard part 3).
+Snapshot install/send for batch-backed groups is fully implemented:
+``_receive_snapshot_chunk`` runs the 4-phase chunked accept (init/pre/
+next/last) host-side and scatters the new floor to the device;
+``_start_snapshot_sender`` spools + streams outbound transfers through
+the shared ``SnapshotSender`` (see ``ra_tpu/runtime/proc.py``); batch-
+and actor-backed members interoperate in either direction.
 """
 
 from __future__ import annotations
@@ -296,7 +299,11 @@ class BatchCoordinator:
         # reusable mailbox pack buffer. Safe to mutate between steps:
         # every step synchronizes on its egress (np.asarray) before the
         # next build, so a zero-copy jnp view is never read after that.
+        # _mbox_in_flight enforces that invariant in code: set when a
+        # build hands out a view, cleared only after the step's egress
+        # sync — a second in-flight build is a bug, not silent corruption
         self._mbox_buf: Optional[np.ndarray] = None
+        self._mbox_in_flight = False
         # guards self.state (donated buffers!) between the step thread and
         # add_group callers
         self._state_lock = threading.Lock()
@@ -606,6 +613,9 @@ class BatchCoordinator:
             packed = jax.device_put(packed, self._shard_mbox)
         self.state, eg_packed = C.consensus_step_packed(self.state, packed)
         eg_np = np.asarray(eg_packed)
+        # egress is host-synced: the device has fully consumed the
+        # mailbox view, so the pack buffer may be reused
+        self._mbox_in_flight = False
         eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
         self.steps += 1
         self.msgs_processed += len(consumed)
@@ -961,6 +971,10 @@ class BatchCoordinator:
     _R = {name: i for i, name in enumerate(C.MBOX_FIELDS)}
 
     def _build_mailbox(self):
+        assert not self._mbox_in_flight, (
+            "mailbox buffer reused while a step still holds its view"
+        )
+        self._mbox_in_flight = True
         cap = self.capacity
         packed = self._mbox_buf
         if packed is None:
@@ -1258,7 +1272,10 @@ class BatchCoordinator:
             if first_idx <= li:
                 # overwriting a divergent suffix: truncated specials are
                 # gone, and any cluster adoption that rode on them must
-                # be rolled back
+                # be rolled back. The ack-suppression key is also
+                # invalidated — its (sid, term, ack) invariant only
+                # holds while acked entries are never truncated
+                g.last_ok_sent = None
                 if g.specials and g.specials[-1] >= first_idx:
                     g.specials = [s for s in g.specials if s < first_idx]
                 if g.cluster_history:
@@ -1473,7 +1490,7 @@ class BatchCoordinator:
         run on the per_group_actor backend."""
         for eff in effs:
             if not is_leader and not isinstance(
-                eff, (fx.ReleaseCursor, fx.Checkpoint)
+                eff, (fx.ReleaseCursor, fx.Checkpoint, fx.TryAppend)
             ):
                 continue
             if isinstance(eff, fx.ReleaseCursor):
@@ -1523,6 +1540,21 @@ class BatchCoordinator:
             elif isinstance(eff, fx.Aux):
                 self.deliver(
                     (g.name, self.name), ("aux", "cast", eff.cmd, None), None
+                )
+            elif isinstance(eff, (fx.Append, fx.TryAppend)):
+                # machine-originated command re-enters via the command
+                # queue: the next step's drain appends it on the leader;
+                # a TryAppend on a non-leader redirects per command
+                # routing (reference: src/ra_server_proc.erl:1604-1615).
+                # Only the leader's copy carries the reply ref — every
+                # replica realises a TryAppend, and a follower's
+                # redirect must not race the leader's ok on one future
+                self.deliver(
+                    (g.name, self.name),
+                    Command(kind=USR, data=eff.cmd,
+                            reply_mode=eff.reply_mode,
+                            from_ref=eff.from_ref if is_leader else None),
+                    None,
                 )
 
     def _sync_snapshot_floor(self, g: GroupHost) -> None:
@@ -1693,13 +1725,35 @@ class BatchCoordinator:
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
             return
         if isinstance(msg, TimeoutNow):
-            # leadership-transfer trigger from any backend's leader: the
-            # target runs an election round immediately. The batch
-            # election path goes through the shared pre-vote machinery
-            # (the old leader answers probes in place, so the round is
-            # never disrupted by its liveness).
-            if g.role != C.R_LEADER and g.voter_status.get(g.self_slot) == "voter":
-                self._handle_rare(g, ElectionTimeout(), None)
+            # leadership-transfer trigger from any backend's leader: a
+            # FORCED election, no pre-vote round (Raft §3.10; matches
+            # the scalar backend's _call_for_election on TimeoutNow) —
+            # one round trip to leadership, and correct independent of
+            # any leader-stickiness in the pre-vote grant.
+            if g.role == C.R_LEADER or g.voter_status.get(g.self_slot) != "voter":
+                return
+            g.role = C.R_CANDIDATE
+            g.term += 1
+            g.leader_slot = -1
+            g.last_contact = time.monotonic()
+            if self.meta is not None:
+                # term AND self-vote must be durable before any vote
+                # request leaves this node (restart double-vote safety)
+                uid = f"{g.cluster_name}_{g.name}"
+                self.meta.store(uid, "current_term", g.term)
+                self.meta.store_sync(uid, "voted_for", (g.name, self.name))
+            self.state = C.force_elections(
+                self.state, jnp.asarray([g.gid], jnp.int32)
+            )
+            self._hot.add(g.gid)  # keep stepping (single-member self-election)
+            outbound2: Dict[str, List] = {}
+
+            def queue_send2(to, m, frm):
+                outbound2.setdefault(to[1], []).append((to, m, frm))
+
+            self._broadcast_vote_req(g, queue_send2, pre=False)
+            for node_name, msgs in outbound2.items():
+                self._send_batch(node_name, msgs)
             return
         if isinstance(msg, tuple) and msg and msg[0] == "transfer_leadership":
             _, target, fut = msg
@@ -2063,6 +2117,7 @@ class BatchCoordinator:
         g.effective_machine_version = meta.machine_version
         g.last_applied = max(g.last_applied, meta.index)
         g.snap_floor = max(g.snap_floor, meta.index)
+        g.last_ok_sent = None  # log identity changed under the ack key
         if g.specials:
             g.specials = [s for s in g.specials if s > meta.index]
         # adopt the snapshot's member set (node-local slot coordinates)

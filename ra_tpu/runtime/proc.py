@@ -41,6 +41,7 @@ from ra_tpu.protocol import (
     NodeEvent,
     ServerId,
     Tick,
+    USR,
 )
 from ra_tpu.server import (
     AWAIT_CONDITION,
@@ -385,6 +386,11 @@ class ServerProc:
     # effect executor (reference: handle_effects src/ra_server_proc.erl:1530)
 
     def _execute(self, effects: List[fx.Effect]) -> None:
+        # machine append effects are collected and front-enqueued as one
+        # ordered block after the loop — per-effect appendleft would
+        # reverse their relative order vs the reference's in-order
+        # next_event realisation (src/ra_server_proc.erl:1604-1615)
+        appends: List[Command] = []
         for eff in effects:
             if isinstance(eff, fx.SendRpc):
                 ok = self.transport.send(eff.to, eff.msg, from_sid=self.server.id)
@@ -440,6 +446,31 @@ class ServerProc:
                     self.enqueue(out)
             elif isinstance(eff, fx.Aux):
                 self.enqueue(("aux", "cast", eff.cmd, None))
+            elif isinstance(eff, fx.Append):
+                # leader-only machine append, re-entering as a command
+                # (reference: {append, ...} -> next_event,
+                # src/ra_server_proc.erl:1604-1609)
+                if self.server.role == LEADER:
+                    appends.append(Command(
+                        kind=USR, data=eff.cmd, reply_mode=eff.reply_mode,
+                        from_ref=eff.from_ref,
+                    ))
+            elif isinstance(eff, fx.TryAppend):
+                # attempted in ANY raft state; a non-leader's command
+                # routing redirects it (reference:
+                # src/ra_server_proc.erl:1610-1615). Only the leader's
+                # copy carries the reply ref — every replica realises
+                # this effect, and a follower's redirect must not race
+                # the leader's ok on the same future
+                appends.append(Command(
+                    kind=USR, data=eff.cmd, reply_mode=eff.reply_mode,
+                    from_ref=(
+                        eff.from_ref if self.server.role == LEADER else None
+                    ),
+                ))
+        # front-enqueue in reverse so the mailbox reads in emission order
+        for cmd in reversed(appends):
+            self.enqueue(cmd, front=True)
 
     def _reply(self, from_ref: Any, reply: Any) -> None:
         setter = getattr(from_ref, "set_result", None)

@@ -49,6 +49,7 @@ from ra_tpu.effects import (
     StateEnter,
     StopServer as StopEffect,
     Timer,
+    TryAppend,
 )
 from ra_tpu.log.api import LogApi
 from ra_tpu.log.meta import MetaApi
@@ -1100,9 +1101,13 @@ class Server:
                     effects.extend(mac_effects)
                     self._reply_applied(entry, cmd, reply, effects, notify)
                 else:
+                    # try_append runs in any raft state (reference:
+                    # src/ra_server_proc.erl:1610-1615); local-option
+                    # sends are evaluated wherever the local member is
                     effects.extend(
                         e for e in mac_effects
-                        if isinstance(e, SendMsg) and "local" in e.options
+                        if (isinstance(e, SendMsg) and "local" in e.options)
+                        or isinstance(e, TryAppend)
                     )
         elif cmd.kind == NOOP:
             if cmd.machine_version > self.effective_machine_version:
@@ -1880,6 +1885,33 @@ class Server:
             self.condition = None
             self._exit_condition(cond.transition_to, effects)
             effects.append(NextEvent(FromPeer(from_peer, msg) if from_peer else msg))
+            return effects
+        if (
+            isinstance(msg, (AppendEntriesRpc, InstallSnapshotRpc))
+            and msg.term > self.current_term
+        ):
+            # a higher-term leader is probing while we hold: adopt the
+            # term and (for AERs) answer with a prompt failure so the
+            # NEW leader rewinds next_index now, instead of hearing
+            # nothing until ConditionTimeout repeats a stale reply
+            # addressed to the old leader. The hold itself is kept —
+            # the condition (wal_up / catch-up resend) still gates what
+            # this server may accept.
+            self._update_term(msg.term)
+            if isinstance(msg, AppendEntriesRpc) and from_peer is not None:
+                self.leader_id = msg.leader_id
+                snap = self.log.snapshot_index_term()
+                li, lt = self.log.last_index_term()
+                nid = dec.aer_failure_next_index(
+                    self.commit_index, li, msg.prev_log_index,
+                    snap[0] if snap else 0,
+                )
+                effects.append(
+                    SendRpc(
+                        from_peer,
+                        AppendEntriesReply(self.current_term, False, nid, li, lt),
+                    )
+                )
             return effects
         if isinstance(msg, LogEvent):
             self.log.handle_event(msg.evt)

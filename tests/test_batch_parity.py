@@ -325,6 +325,45 @@ def test_batch_aux_machine_and_kv_model():
         stop_all(coords)
 
 
+class ChainMachine(Machine):
+    """Emits append/try_append effects (reference machine-effect
+    vocabulary: src/ra_machine.erl:131-159)."""
+
+    def init(self, config):
+        return {"seen": ()}
+
+    def apply(self, meta, cmd, state):
+        state = dict(state, seen=state["seen"] + (cmd,))
+        if isinstance(cmd, tuple) and cmd[0] == "chain":
+            return state, "ok", [fx.Append(("chained", cmd[1]))]
+        if isinstance(cmd, tuple) and cmd[0] == "try_chain":
+            return state, "ok", [fx.TryAppend(("chained2", cmd[1]))]
+        return state, "ok", []
+
+
+def test_batch_append_and_try_append_effects():
+    """append/try_append machine effects on the batch backend: the
+    machine-originated command replicates through consensus and applies
+    exactly once (follower copies of try_append redirect, not re-append)."""
+    coords = mk_cluster("ap", machine=ChainMachine)
+    try:
+        sid = ("apg0", "ap0")
+        seen = lambda k: coords[k].by_name["apg0"].machine_state["seen"]  # noqa: E731
+        r, _ = api.process_command(sid, ("chain", 7), timeout=20)
+        assert r == "ok"
+        await_(lambda: ("chained", 7) in seen(0), what="append effect applied")
+        await_(lambda: ("chained", 7) in seen(1), what="append replicated")
+        r, _ = api.process_command(sid, ("try_chain", 9), timeout=20)
+        assert r == "ok"
+        await_(lambda: ("chained2", 9) in seen(0), what="try_append applied")
+        await_(lambda: ("chained2", 9) in seen(2), what="try_append replicated")
+        time.sleep(0.3)
+        assert seen(0).count(("chained", 7)) == 1
+        assert seen(0).count(("chained2", 9)) == 1
+    finally:
+        stop_all(coords)
+
+
 def test_batch_transfer_leadership():
     """Leadership transfer on the batch backend (parity with
     ra:transfer_leadership): gate checks, hand-off via TimeoutNow, and

@@ -73,6 +73,14 @@ class EffectMachine(Machine):
                 return state, "ok", [fx.SendMsg(cmd[1], ("hello", meta["index"]), ())]
             if op == "mod_call":
                 return state, "ok", [fx.ModCall(cmd[1], (meta["index"],))]
+            if op == "chain":
+                # {append, Cmd}: machine appends a NEW user command
+                # (reference: src/ra_machine.erl:131-159)
+                return state, "ok", [fx.Append(("chained", cmd[1]))]
+            if op == "try_chain":
+                # {try_append, Cmd, ReplyMode}: append attempted in any
+                # raft state (reference: src/ra_server_proc.erl:1610-1615)
+                return state, "ok", [fx.TryAppend(("chained2", cmd[1]))]
         return state, ("applied", cmd), []
 
     def overview(self, state):
@@ -211,6 +219,37 @@ def test_mod_call_invoked_with_args(cluster):
     assert r == "ok"
     await_(lambda: calls, what="mod_call invoked")
     assert isinstance(calls[0], int) and calls[0] >= 1
+
+
+def test_append_effect_appends_new_command(cluster):
+    """The append effect feeds a machine-originated command back through
+    consensus: it must replicate to every member and apply exactly once
+    (followers apply the same entry but never re-append — the effect is
+    leader-only)."""
+    ids = cluster
+    r, _ = api.process_command(ids[0], ("chain", 7), timeout=10)
+    assert r == "ok"
+    await_(lambda: ("chained", 7) in _log_of(ids[0]),
+           what="appended command applied")
+    await_(lambda: ("chained", 7) in _log_of(ids[1]),
+           what="appended command replicated")
+    time.sleep(0.3)
+    assert _log_of(ids[0]).count(("chained", 7)) == 1
+
+
+def test_try_append_effect_applies_exactly_once(cluster):
+    """try_append runs in ANY raft state: followers route their copy of
+    the effect through normal command routing (redirect, no re-append),
+    so the command still lands exactly once."""
+    ids = cluster
+    r, _ = api.process_command(ids[0], ("try_chain", 9), timeout=10)
+    assert r == "ok"
+    await_(lambda: ("chained2", 9) in _log_of(ids[0]),
+           what="try_append command applied")
+    await_(lambda: ("chained2", 9) in _log_of(ids[1]),
+           what="try_append command replicated")
+    time.sleep(0.3)
+    assert _log_of(ids[0]).count(("chained2", 9)) == 1
 
 
 def test_effects_leader_only_on_apply(cluster):
