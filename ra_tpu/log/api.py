@@ -133,6 +133,26 @@ class LogApi:
     def read_snapshot(self) -> Optional[Tuple[SnapshotMeta, Any]]:
         raise NotImplementedError
 
+    # -- streaming snapshot transfer (reference: the snapshot behaviour's
+    # begin_read/read_chunk + begin_accept/accept_chunk/complete_accept,
+    # src/ra_snapshot.erl:135-210,742-860). Defaults return None: logs
+    # without a disk-backed snapshot store (MemoryLog) fall back to the
+    # whole-blob transfer path. ---------------------------------------------
+
+    def begin_snapshot_read(self, chunk_size: int):
+        """-> (meta, byte-chunk iterator reading from DISK) or None."""
+        return None
+
+    def begin_accept_snapshot(self, meta: SnapshotMeta):
+        """-> ChunkAccept spooling chunks to disk, or None."""
+        return None
+
+    def complete_accept_snapshot(self, accept) -> Any:
+        """Seal an accept started by :meth:`begin_accept_snapshot`:
+        decode + promote the capture, apply the log-side bookkeeping of
+        :meth:`install_snapshot`, return the machine state."""
+        raise NotImplementedError
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:

@@ -266,6 +266,10 @@ class Log(LogApi):
 
     def install_snapshot(self, meta: SnapshotMeta, machine_state: Any) -> List[Any]:
         self.snapshots.write(meta, machine_state, kind=SNAPSHOT)
+        self._post_install(meta)
+        return []
+
+    def _post_install(self, meta: SnapshotMeta) -> None:
         self._post_snapshot(meta)
         if self._last_index < meta.index:
             self._last_index = meta.index
@@ -273,7 +277,20 @@ class Log(LogApi):
         if self._written_index < meta.index:
             self._written_index = meta.index
             self._written_term = meta.term
-        return []
+
+    # -- streaming transfer (reference: src/ra_snapshot.erl:135-210,
+    # 742-860) -------------------------------------------------------------
+
+    def begin_snapshot_read(self, chunk_size: int):
+        return self.snapshots.begin_read_stream(chunk_size)
+
+    def begin_accept_snapshot(self, meta: SnapshotMeta):
+        return self.snapshots.begin_accept(meta)
+
+    def complete_accept_snapshot(self, accept) -> Any:
+        state = accept.complete()  # decodes from disk, promotes the dir
+        self._post_install(accept.meta)
+        return state
 
     def _post_snapshot(self, meta: SnapshotMeta) -> None:
         live = Seq.from_list(meta.live_indexes)

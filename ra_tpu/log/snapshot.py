@@ -114,6 +114,78 @@ class PickleCodec(SnapshotCodec):
             return False
 
 
+class ChunkAccept:
+    """Incremental accept of a transferred snapshot body: every chunk is
+    appended straight to a spool file on disk — peak extra memory is
+    O(chunk), never O(snapshot) (reference: begin_accept/accept_chunk/
+    complete_accept stream to disk, src/ra_snapshot.erl:742-860). On
+    ``complete`` the body gets the CRC trailer, the machine state is
+    decoded by a STREAMING restricted unpickle from the file, and the
+    directory is promoted with the same crash-safe rename protocol as a
+    local snapshot write."""
+
+    def __init__(self, store: "SnapshotStore", meta: SnapshotMeta):
+        self.store = store
+        self.meta = meta
+        d = store._kind_dir(SNAPSHOT)
+        self.tmp = os.path.join(d, store._dirname(meta) + ".accepting")
+        if os.path.exists(self.tmp):
+            shutil.rmtree(self.tmp)
+        os.makedirs(self.tmp)
+        self.path = os.path.join(self.tmp, "snapshot.dat")
+        self._f = open(self.path, "wb")
+        self._crc = 0
+        self.chunks_accepted = 0
+        self.done = False
+
+    def accept_chunk(self, data: bytes) -> None:
+        self._f.write(data)
+        self._crc = zlib.crc32(data, self._crc)
+        self.chunks_accepted += 1
+
+    def abort(self) -> None:
+        self.done = True
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+    def complete(self) -> Any:
+        store = self.store
+        self._f.write(_TRAILER.pack(self._crc))
+        self._f.flush()
+        if store.sync_pool is None:
+            os.fsync(self._f.fileno())
+        self._f.close()
+        if store.sync_pool is not None:
+            store.sync_pool.sync_path(self.path)
+        # decode BEFORE promoting: an undecodable body (wire-allowlist
+        # miss, truncation) must never become the current snapshot.
+        # Streaming unpickle: the blob is never materialized as bytes.
+        from ra_tpu.utils.wire import wire_load_file
+
+        try:
+            with open(self.path, "rb") as rf:
+                state = wire_load_file(rf)
+        except Exception:
+            self.abort()
+            raise
+        PickleCodec._write_file(
+            os.path.join(self.tmp, "meta.dat"), self.meta, store.sync_pool
+        )
+        d = store._kind_dir(SNAPSHOT)
+        final = os.path.join(d, store._dirname(self.meta))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(self.tmp, final)
+        sync_dir(d)
+        store._prune_count(SNAPSHOT, 2)
+        store._prune_older(CHECKPOINT, self.meta.index + 1)
+        self.done = True
+        return state
+
+
 class SnapshotStore:
     """Per-server snapshot/checkpoint directory manager."""
 
@@ -124,7 +196,13 @@ class SnapshotStore:
         self.max_checkpoints = max_checkpoints
         self.sync_pool = sync_pool
         for kind in (SNAPSHOT, CHECKPOINT, RECOVERY):
-            os.makedirs(os.path.join(server_dir, kind), exist_ok=True)
+            d = os.path.join(server_dir, kind)
+            os.makedirs(d, exist_ok=True)
+            # a crash mid-write/mid-accept leaves .writing/.accepting
+            # spool dirs; they are not valid captures — clear them
+            for name in os.listdir(d):
+                if name.endswith(".writing") or name.endswith(".accepting"):
+                    shutil.rmtree(os.path.join(d, name), ignore_errors=True)
 
     # -- naming -------------------------------------------------------------
 
@@ -237,6 +315,67 @@ class SnapshotStore:
                 yield blob[off : off + chunk_size]
 
         return chunks()
+
+    def begin_read_stream(
+        self, chunk_size: int
+    ) -> Optional[Tuple[SnapshotMeta, Iterator[bytes]]]:
+        """Open the current snapshot body for chunked sending straight
+        FROM DISK — the state object is never decoded and the blob never
+        materialized (reference: begin_read/read_chunk,
+        src/ra_snapshot.erl:135-210). The fd is opened here, on the
+        owning thread; the iterator may then be drained from a sender
+        thread (an open fd survives pruning of the directory). The CRC
+        trailer is verified as the stream drains — a corrupt body raises
+        before the last chunk is yielded. Returns None when no valid
+        snapshot exists or the codec's file layout is not the default."""
+        if type(self.codec) is not PickleCodec:
+            return None  # unknown on-disk layout: caller falls back
+        for idx, term, path in reversed(self._list(SNAPSHOT)):
+            try:
+                meta = self.codec.read_meta(path)
+            except Exception:
+                continue
+            try:
+                f = open(os.path.join(path, "snapshot.dat"), "rb")
+            except OSError:
+                continue
+            size = os.fstat(f.fileno()).st_size - _TRAILER.size
+            if size < 0:
+                f.close()
+                continue
+            f.seek(size)
+            (crc_stored,) = _TRAILER.unpack(f.read(_TRAILER.size))
+            f.seek(0)
+
+            def chunks(f=f, size=size, crc_stored=crc_stored):
+                try:
+                    crc = 0
+                    left = size
+                    pending = None  # one-chunk buffer so CRC checks
+                    while left > 0:  # before the final chunk is yielded
+                        buf = f.read(min(chunk_size, left))
+                        if not buf:
+                            raise IOError("short read streaming snapshot")
+                        left -= len(buf)
+                        crc = zlib.crc32(buf, crc)
+                        if pending is not None:
+                            yield pending
+                        pending = buf
+                    if crc_stored and crc != crc_stored:
+                        raise IOError("snapshot crc mismatch while streaming")
+                    yield pending if pending is not None else b""
+                finally:
+                    f.close()
+
+            return meta, chunks()
+        return None
+
+    def begin_accept(self, meta: SnapshotMeta) -> Optional[ChunkAccept]:
+        """Start an incremental disk-spooled accept (None when the codec
+        is not the default — caller falls back to in-RAM accumulation)."""
+        if type(self.codec) is not PickleCodec:
+            return None
+        return ChunkAccept(self, meta)
 
     def accept_chunks(self, meta: SnapshotMeta, chunks: List[bytes]) -> Any:
         state = decode_snapshot_chunks(chunks)  # untrusted transfer bytes

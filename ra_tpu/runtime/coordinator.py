@@ -2071,8 +2071,18 @@ class BatchCoordinator:
         g.last_contact = time.monotonic()
         if msg.chunk_phase == CHUNK_INIT:
             # INIT always starts a fresh accumulator — a retried transfer
-            # at the same index must not append onto stale chunks
-            g.snap_accept = {"meta": msg.meta, "chunks": [], "next": 1}
+            # at the same index must not append onto stale chunks. Chunk
+            # bodies spool straight to disk when the group's log store
+            # supports it ("accept" is None on memory logs: RAM fallback)
+            old = g.snap_accept
+            if old is not None:
+                oa = old.get("accept")
+                if oa is not None and not oa.done:
+                    oa.abort()
+            g.snap_accept = {
+                "meta": msg.meta, "chunks": [], "next": 1,
+                "accept": g.log.begin_accept_snapshot(msg.meta),
+            }
             send_one(InstallSnapshotAck(g.term, msg.chunk_no))
             return
         acc = g.snap_accept
@@ -2090,7 +2100,16 @@ class BatchCoordinator:
             return
         if msg.chunk_no > acc["next"]:
             return
-        acc["chunks"].append(msg.data)
+        a = acc.get("accept")
+        if a is not None and isinstance(msg.data, (bytes, bytearray)):
+            a.accept_chunk(msg.data)  # straight to the disk spool
+        else:
+            if a is not None:
+                # non-byte chunk (in-proc direct-object transfer): falls
+                # back to RAM — always the first chunk, nothing is lost
+                a.abort()
+                acc["accept"] = a = None
+            acc["chunks"].append(msg.data)
         acc["next"] += 1
         if msg.chunk_phase != CHUNK_LAST:
             send_one(InstallSnapshotAck(g.term, msg.chunk_no))
@@ -2098,8 +2117,15 @@ class BatchCoordinator:
         # complete: install host-side, then scatter the floor to device
         from ra_tpu.log.snapshot import decode_snapshot_chunks
 
+        meta = acc["meta"]
         try:
-            state_obj = decode_snapshot_chunks(acc["chunks"])
+            if a is not None:
+                # seal + streaming-decode + promote: the spool dir IS
+                # the new snapshot; no second serialization
+                state_obj = g.log.complete_accept_snapshot(a)
+            else:
+                state_obj = decode_snapshot_chunks(acc["chunks"])
+                g.log.install_snapshot(meta, state_obj)
         except Exception:
             # undecodable body (e.g. a machine-state type the wire
             # allowlist does not know here): abort THIS transfer so a
@@ -2111,8 +2137,6 @@ class BatchCoordinator:
                 self.name, g.name,
             )
             return
-        meta = acc["meta"]
-        g.log.install_snapshot(meta, state_obj)
         g.machine_state = state_obj
         g.effective_machine_version = meta.machine_version
         g.last_applied = max(g.last_applied, meta.index)
@@ -2175,10 +2199,18 @@ class BatchCoordinator:
     def _start_snapshot_sender(self, g: GroupHost, to: ServerId) -> None:
         if to in g.snap_senders:
             return
-        got = g.log.read_snapshot()
-        if got is None:
-            return
-        meta, state_obj = got
+        # prefer the disk-streaming reader (no decode, no blob in RAM);
+        # memory-backed group logs fall back to the whole-state capture
+        chunk_size = 1024 * 1024
+        state_obj = chunk_iter = None
+        stream = g.log.begin_snapshot_read(chunk_size)
+        if stream is not None:
+            meta, chunk_iter = stream
+        else:
+            got = g.log.read_snapshot()
+            if got is None:
+                return
+            meta, state_obj = got
         live_entries = (
             g.log.sparse_read(list(meta.live_indexes)) if meta.live_indexes else []
         )
@@ -2186,7 +2218,7 @@ class BatchCoordinator:
 
         sender = SnapshotSender(
             self._SenderShim(self, g), to, meta, state_obj, live_entries, g.term,
-            1024 * 1024,
+            chunk_size, chunk_iter=chunk_iter,
         )
         g.snap_senders[to] = sender
         sender.start()

@@ -59,9 +59,16 @@ class SnapshotSender:
     """Chunked snapshot sender to one peer (the reference spawns a
     transient process per transfer: src/ra_server_proc.erl:1691-1735).
 
-    The snapshot payload (meta, pickled-state chunks, live entries) is
-    captured on the owning proc thread *before* this thread starts — the
-    log is single-owner and must not be read concurrently."""
+    The snapshot payload (meta, body source, live entries) is captured
+    on the owning proc thread *before* this thread starts — the log is
+    single-owner and must not be read concurrently. Preferred body
+    source is ``chunk_iter``, a byte-chunk iterator reading the
+    already-serialized body straight FROM DISK (the fd was opened at
+    capture time, so the stream survives snapshot pruning) — peak sender
+    memory is O(chunk), matching the reference's begin_read/read_chunk
+    protocol (src/ra_snapshot.erl:135-210). ``state_obj`` is the
+    fallback for memory-backed logs: pickled in one blob on this
+    thread."""
 
     def __init__(
         self,
@@ -72,11 +79,13 @@ class SnapshotSender:
         live_entries: list,
         term: int,
         chunk_size: int,
+        chunk_iter=None,
     ):
         self.proc = proc
         self.to = to
         self.meta = meta
         self.state_obj = state_obj
+        self.chunk_iter = chunk_iter
         self.chunk_size = chunk_size
         self.live_entries = live_entries
         self.term = term
@@ -118,15 +127,20 @@ class SnapshotSender:
     def _run(self) -> None:
         proc = self.proc
         try:
-            # serialization happens HERE, off the consensus threads: the
-            # state object was captured immutably by the owning thread
-            import pickle
+            if self.chunk_iter is not None:
+                chunk_src = self.chunk_iter  # lazy reads from disk
+            else:
+                # memory-backed fallback: serialization happens HERE,
+                # off the consensus threads — the state object was
+                # captured immutably by the owning thread
+                import pickle
 
-            blob = pickle.dumps(self.state_obj)
-            cs = self.chunk_size
-            self.chunks = [
-                blob[o : o + cs] for o in range(0, max(len(blob), 1), cs)
-            ] or [b""]
+                blob = pickle.dumps(self.state_obj)
+                cs = self.chunk_size
+                chunk_src = iter(
+                    [blob[o : o + cs] for o in range(0, max(len(blob), 1), cs)]
+                    or [b""]
+                )
             timeout = proc.snapshot_ack_timeout_s
 
             def send(no, phase, data=b""):
@@ -159,14 +173,16 @@ class SnapshotSender:
                 if finish_on(self._await_ack(no, timeout)):
                     return
                 no += 1
-            for i, chunk in enumerate(self.chunks):
-                last = i == len(self.chunks) - 1
-                send(no, CHUNK_LAST if last else CHUNK_NEXT, chunk)
-                if last:
-                    break
+            # one-chunk lookahead tags the final chunk CHUNK_LAST while
+            # holding at most two chunks in memory
+            pending = next(chunk_src, b"")
+            for chunk in chunk_src:
+                send(no, CHUNK_NEXT, pending)
                 if finish_on(self._await_ack(no, timeout)):
                     return
                 no += 1
+                pending = chunk
+            send(no, CHUNK_LAST, pending)
             # final result arrives as InstallSnapshotResult; wait for it
             deadline = time.monotonic() + timeout
             with self.acks:
@@ -629,13 +645,22 @@ class ServerProc:
         if peer is not None and status_kind(peer.status) == "snapshot_backoff":
             peer.status = ("sending_snapshot", peer.status[1])
         # capture the payload here, on the proc thread: the log is
-        # single-owner and must not be read from the sender thread
-        got = self.server.log.read_snapshot()
-        if got is None:
-            if peer is not None and status_kind(peer.status) == "sending_snapshot":
-                peer.status = "normal"
-            return
-        meta, state = got
+        # single-owner and must not be read from the sender thread.
+        # Prefer the disk-streaming reader (no decode, no blob) and fall
+        # back to the whole-state read for memory-backed logs
+        chunk_size = self.node.config.snapshot_chunk_size
+        state = None
+        chunk_iter = None
+        stream = self.server.log.begin_snapshot_read(chunk_size)
+        if stream is not None:
+            meta, chunk_iter = stream
+        else:
+            got = self.server.log.read_snapshot()
+            if got is None:
+                if peer is not None and status_kind(peer.status) == "sending_snapshot":
+                    peer.status = "normal"
+                return
+            meta, state = got
         live_entries = (
             self.server.log.sparse_read(list(meta.live_indexes))
             if meta.live_indexes
@@ -643,7 +668,7 @@ class ServerProc:
         )
         sender = SnapshotSender(
             self, to, meta, state, live_entries, self.server.current_term,
-            self.node.config.snapshot_chunk_size,
+            chunk_size, chunk_iter=chunk_iter,
         )
         self._senders[to] = sender
         sender.start()

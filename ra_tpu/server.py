@@ -1731,9 +1731,16 @@ class Server:
                 return effects
             if msg.chunk_phase == CHUNK_INIT:
                 # INIT always starts a fresh accumulator — a retried
-                # transfer at the same index must not extend stale chunks
+                # transfer at the same index must not extend stale
+                # chunks. Chunk bodies spool straight to disk when the
+                # log's snapshot store supports it (reference:
+                # begin_accept, src/ra_snapshot.erl:742-860); "accept"
+                # is None on memory-backed logs (in-RAM fallback).
+                self._abort_snap_accept()
                 self._snap_accept = {
-                    "meta": msg.meta, "chunks": [], "next_chunk": 1, "from": from_peer,
+                    "meta": msg.meta, "chunks": [], "next_chunk": 1,
+                    "from": from_peer,
+                    "accept": self.log.begin_accept_snapshot(msg.meta),
                 }
                 effects.append(
                     SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
@@ -1764,7 +1771,17 @@ class Server:
                 return effects
             if msg.chunk_no > acc["next_chunk"]:
                 return effects
-            acc["chunks"].append(msg.data)
+            a = acc.get("accept")
+            if a is not None and isinstance(msg.data, (bytes, bytearray)):
+                a.accept_chunk(msg.data)  # straight to the disk spool
+            else:
+                if a is not None:
+                    # a non-byte chunk (in-proc direct-object transfer)
+                    # cannot spool to disk: fall back to in-RAM — always
+                    # the transfer's first chunk, so nothing is lost
+                    a.abort()
+                    acc["accept"] = None
+                acc["chunks"].append(msg.data)
             acc["next_chunk"] += 1
             if msg.chunk_phase == CHUNK_LAST:
                 return self._complete_snapshot(msg, from_peer, effects)
@@ -1773,13 +1790,13 @@ class Server:
             )
             return effects
         if isinstance(msg, ElectionTimeout):
-            self._snap_accept = None
+            self._abort_snap_accept()
             self._become_follower(effects)
             return effects
         if isinstance(msg, AppendEntriesRpc) and msg.term >= self.current_term:
             # leader moved on; abandon the transfer
             self._update_term(msg.term)
-            self._snap_accept = None
+            self._abort_snap_accept()
             self._become_follower(effects, leader=msg.leader_id)
             effects.append(NextEvent(FromPeer(from_peer, msg)))
             return effects
@@ -1789,7 +1806,7 @@ class Server:
             # must not (reference: ..._lower_term)
             if msg.term > self.current_term:
                 self._update_term(msg.term)
-                self._snap_accept = None
+                self._abort_snap_accept()
                 self._become_follower(effects)
                 effects.append(NextEvent(FromPeer(from_peer, msg)))
             return effects
@@ -1802,16 +1819,31 @@ class Server:
             return effects
         return effects
 
+    def _abort_snap_accept(self) -> None:
+        """Drop an in-progress transfer, cleaning any disk spool."""
+        acc = self._snap_accept
+        self._snap_accept = None
+        if acc is not None:
+            a = acc.get("accept")
+            if a is not None and not a.done:
+                a.abort()
+
     def _complete_snapshot(
         self, msg: InstallSnapshotRpc, from_peer: Optional[ServerId], effects: EffectList
     ) -> EffectList:
         acc = self._snap_accept
         assert acc is not None
-        chunks = acc["chunks"]
-        machine_state = self._decode_snapshot(chunks)
         old_meta = self.log.snapshot_meta()
         old_state = self.machine_state
-        self.log.install_snapshot(msg.meta, machine_state)
+        a = acc.get("accept")
+        if a is not None:
+            # disk-spooled accept: seal + streaming-decode + promote in
+            # one step (the capture directory IS the new snapshot — no
+            # second serialization of the state)
+            machine_state = self.log.complete_accept_snapshot(a)
+        else:
+            machine_state = self._decode_snapshot(acc["chunks"])
+            self.log.install_snapshot(msg.meta, machine_state)
         self.machine_state = machine_state
         self.effective_machine_version = msg.meta.machine_version
         self.commit_index = max(self.commit_index, msg.meta.index)

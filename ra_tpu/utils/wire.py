@@ -68,3 +68,11 @@ class _RestrictedUnpickler(pickle.Unpickler):
 def wire_loads(payload: bytes):
     """Deserialize untrusted bytes through the allowlist."""
     return _RestrictedUnpickler(io.BytesIO(payload)).load()
+
+
+def wire_load_file(f):
+    """Deserialize untrusted bytes from a binary file object through the
+    allowlist — STREAMING: the unpickler reads incrementally, so a large
+    snapshot body decodes without ever materializing the file as one
+    bytes object (used by the chunked snapshot accept path)."""
+    return _RestrictedUnpickler(f).load()
