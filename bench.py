@@ -1,17 +1,22 @@
 """Benchmark: multi-raft throughput on the tpu_batch coordinator backend.
 
-Headline (default): end-to-end replicated commands/sec — 10,240 raft
-groups x 3 replicas spread over three batch coordinators in this
-process, no-op machine (the reference ra_bench workload shape:
-src/ra_bench.erl), commands pipelined to every group leader, measured
-until every group has applied everything. This exercises the whole
-pipeline: host append -> device decision steps (AER accept / reply
-bookkeeping / quorum scan, fused over all groups) -> follower accept ->
-commit -> apply. The coordinators are stepped cooperatively from one
-thread (same message flow as the threaded mode; on the 1-core bench
-host, thread ping-pong would only add GIL handoff latency).
+Headline (default): end-to-end DURABLE replicated commands/sec —
+10,240 raft groups x 3 replicas spread over three batch coordinators in
+this process, every replica on a real WAL-backed log (one shared WAL
+per coordinator, batched fsync across all its groups — the amortized-
+durability design the framework exists to prove, reference:
+docs/internals/INTERNALS.md:16-19), no-op machine (the reference
+ra_bench workload shape: src/ra_bench.erl), commands pipelined to every
+group leader, measured until every group has applied everything.
+Commit acks ride the written-event watermarks exactly as production
+does. Alongside commands/sec the headline reports p50/p99 COMMIT
+LATENCY (command delivery -> group apply at the leader), sampled over
+a fixed subset of groups — the reference tracks the same gauge
+(src/ra.hrl:424-425, src/ra_server.erl:3265-3277).
 
-``--decisions`` instead measures the raw fused decision-kernel
+``--no-wal`` runs the same pipeline on auto-durable in-memory logs —
+the host routing ceiling with storage out of the picture (secondary
+artifact). ``--decisions`` measures the raw fused decision-kernel
 throughput at 10k groups (the device ceiling, no host routing).
 
 The reference publishes no benchmark numbers (BASELINE.md: published={});
@@ -19,7 +24,7 @@ The reference publishes no benchmark numbers (BASELINE.md: published={});
 rate of 100,000 ops/sec (src/ra_bench.erl:38), the only quantitative
 throughput anchor it ships.
 
-Output: ONE JSON line {metric, value, unit, vs_baseline}.
+Output: ONE JSON line {metric, value, unit, vs_baseline, p50_ms, p99_ms}.
 """
 
 import argparse
@@ -74,13 +79,15 @@ def _retry_on_cpu_or_fail() -> None:
     os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
 
 
-def bench_pipeline(groups: int, cmds: int) -> dict:
+def bench_pipeline(groups: int, cmds: int, wal: bool = True,
+                   workdir: str = None) -> dict:
     """Cooperative-scheduler pipeline bench: the three coordinators are
     stepped round-robin from this thread (their threaded step loops are
-    never started). On a multi-core host the threaded mode adds
-    parallelism, but the driver's bench box has one core, where thread
-    ping-pong only adds GIL handoff latency; the message flow and the
-    per-step work are identical either way."""
+    never started; the WAL batching/fsync threads DO run). On a
+    multi-core host the threaded mode adds parallelism, but the
+    driver's bench box has one core, where thread ping-pong only adds
+    GIL handoff latency; the message flow and the per-step work are
+    identical either way (docs/INTERNALS.md, bench methodology)."""
     import jax
     import jax.numpy as jnp
 
@@ -122,13 +129,53 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
         BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3, idle_sleep_s=0)
         for i in range(3)
     ]
+    storage = []
+    if wal:
+        # one shared WAL + segment writer per coordinator: every group's
+        # appends ride the same file and the same batched fsync — the
+        # reference's core durability amortization (one gen_batch_server
+        # WAL per system, docs/internals/INTERNALS.md:16-19)
+        import shutil
+        import tempfile
+
+        from ra_tpu.log.log import Log
+        from ra_tpu.log.segment_writer import SegmentWriter
+        from ra_tpu.log.tables import TableRegistry
+        from ra_tpu.log.wal import Wal
+
+        base = workdir or tempfile.mkdtemp(prefix="ra_bench_wal_")
+        for i, c in enumerate(coords):
+            d = os.path.join(base, f"bench{i}")
+            tables = TableRegistry()
+
+            def notify(uid, evt, c=c, i=i):
+                c.deliver((uid, f"bench{i}"), ("log_event", evt), None)
+
+            sw = SegmentWriter(os.path.join(d, "data"), tables, notify)
+            # big batches: fewer fsyncs AND fewer written-event rounds
+            # per pipelined burst (one event per group per batch)
+            w = Wal(os.path.join(d, "wal"), tables, notify,
+                    segment_writer=sw, max_batch_size=65536)
+            # bulk written-event channel: one ingress lock round per
+            # fsync batch instead of one per group
+            w.notify_many = (
+                lambda items, c=c, i=i: c.deliver_many(
+                    [((uid, f"bench{i}"), ("log_event", evt), None)
+                     for uid, evt in items]
+                )
+            )
+            storage.append((tables, w, sw, d, base))
+
+        def mk_log(i, uid):
+            tables, w, _sw, d, _ = storage[i]
+            return Log(uid, os.path.join(d, "data", uid), tables, w)
     try:
         members = lambda g: [(f"g{g}", f"bench{i}") for i in range(3)]  # noqa: E731
-        for c in coords:
+        for i, c in enumerate(coords):
             c.add_groups(
                 [
-                    (f"g{g}", f"cl{g}", members(g),
-                     BenchMachine())
+                    (f"g{g}", f"cl{g}", members(g), BenchMachine(),
+                     mk_log(i, f"g{g}") if wal else None)
                     for g in range(groups)
                 ]
             )
@@ -158,20 +205,52 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
         # floor below is exact
         while step_all():
             pass
+        import numpy as np
+
         base = coords[0]._applied_np[:groups].copy()
+        names = [f"g{g}" for g in range(groups)]
+        # fixed sample of groups for the commit-latency distribution
+        sample = np.arange(0, groups, max(1, groups // 256), dtype=np.int64)
 
         def run_wave(n_waves: int) -> None:
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             for _ in range(n_waves):
                 base.__iadd__(1)
-                coords[0].deliver_many(
-                    [((f"g{g}", "bench0"), cmd, None) for g in range(groups)]
-                )
+                coords[0].deliver_commands(names, cmd)
             while time.time() < deadline:
                 step_all()
                 if all((c._applied_np[:groups] >= base).all() for c in coords):
                     return
             raise TimeoutError("wave did not complete")
+
+        def latency_phase(n_waves: int) -> list:
+            """p50/p99 commit latency: the sampled groups (256 of them)
+            each issue ONE command while the other ~10k groups sit idle;
+            latency = delivery -> leader apply per sampled group. This
+            is the unloaded commit round trip (append, replicate, fsync
+            on three logs, quorum, apply) — the reference's
+            commit-latency gauge measures the same thing per entry; the
+            throughput passes above measure saturation separately."""
+            lats: list = []
+            cmd = Command(kind=USR, data=1, reply_mode="noreply")
+            sample_names = [f"g{g}" for g in sample]
+            for _ in range(n_waves):
+                base[sample] += 1
+                done = np.zeros(len(sample), bool)
+                t0 = time.perf_counter()
+                coords[0].deliver_commands(sample_names, cmd)
+                while time.time() < deadline:
+                    step_all()
+                    now = time.perf_counter()
+                    newly = ~done & (coords[0]._applied_np[sample] >= base[sample])
+                    if newly.any():
+                        lats.extend([now - t0] * int(newly.sum()))
+                        done |= newly
+                    if all((c._applied_np[:groups] >= base).all() for c in coords):
+                        break
+                else:
+                    raise TimeoutError("latency wave did not complete")
+            return lats
 
         try:
             run_wave(1)  # warmup: compiles remaining scatter/step shapes
@@ -218,19 +297,41 @@ def bench_pipeline(groups: int, cmds: int) -> dict:
                 _retry_on_cpu_or_fail()
             best = max(best, total / dt)
 
+        try:
+            lats = latency_phase(8)
+        except TimeoutError:
+            print("bench error: latency phase incomplete", file=sys.stderr)
+            _retry_on_cpu_or_fail()
+        p50 = float(np.percentile(lats, 50) * 1000)
+        p99 = float(np.percentile(lats, 99) * 1000)
+
         return {
             "metric": (
-                f"replicated commands/sec ({groups} groups x 3 replicas, "
+                f"durable replicated commands/sec ({groups} groups x 3 "
+                f"replicas, {'shared-WAL fsync-gated logs' if wal else 'in-memory logs (routing ceiling)'}, "
                 f"tpu_batch coordinators, device {jax.devices()[0].platform}, "
-                f"best of 3 passes)"
+                f"best of 3 passes; p50/p99 = unloaded commit latency over "
+                f"{len(sample)} sampled groups)"
             ),
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
+            "p50_ms": round(p50, 2),
+            "p99_ms": round(p99, 2),
         }
     finally:
         for c in coords:
             c.stop()
+        for tables, w, sw, d, _b in storage:
+            try:
+                w.close()
+                sw.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if storage and workdir is None:
+            import shutil
+
+            shutil.rmtree(storage[0][4], ignore_errors=True)
 
 
 def bench_decisions(groups: int, steps: int) -> dict:
@@ -284,9 +385,14 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true", help="small/fast run")
     ap.add_argument("--decisions", action="store_true",
                     help="raw decision-kernel throughput instead of pipeline")
+    ap.add_argument("--no-wal", action="store_true",
+                    help="in-memory logs: host routing ceiling (the "
+                         "headline default is WAL-backed/durable)")
     ap.add_argument("--groups", type=int, default=None)
     ap.add_argument("--cmds", type=int, default=None)
     ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--workdir", default=None,
+                    help="WAL/segment directory (default: temp dir)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -295,12 +401,13 @@ def main() -> None:
         g = args.groups or (1024 if args.smoke else 10240)
         out = bench_decisions(g, args.steps or (10 if args.smoke else 200))
     else:
-        # 48 commands in flight per group — deep pipelining is the
+        # 96 commands in flight per group — deep pipelining is the
         # reference harness's own methodology (PIPE_SIZE=500 in-flight
-        # per client, src/ra_bench.erl:18-19); the AER batch cap (128)
-        # still bounds every RPC
+        # per client x 5 clients, src/ra_bench.erl:18-19); the AER
+        # batch cap (128) still bounds every RPC
         g = args.groups or (128 if args.smoke else 10240)
-        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 48))
+        out = bench_pipeline(g, args.cmds or (3 if args.smoke else 96),
+                             wal=not args.no_wal, workdir=args.workdir)
     print(json.dumps(out))
 
 

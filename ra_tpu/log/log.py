@@ -105,6 +105,41 @@ class Log(LogApi):
         self._last_index = entry.index
         self._last_term = entry.term
 
+    def append_many(self, entries: Sequence[Entry]) -> None:
+        """Leader bulk append: one memtable run insert, one WAL lock
+        round, and one serialization per DISTINCT command object (a
+        pipelined wave fans the same Command instance across entries —
+        pickling it once per batch instead of once per entry)."""
+        if not entries:
+            return
+        if entries[0].index != self._last_index + 1:
+            raise ValueError(
+                f"non-contiguous append {entries[0].index} after "
+                f"{self._last_index}"
+            )
+        self._bulk_insert(entries)
+        self._last_index = entries[-1].index
+        self._last_term = entries[-1].term
+
+    def _bulk_insert(self, entries: Sequence[Entry]) -> None:
+        tid = self.mt.insert_run(entries)
+        if tid is None:
+            # overwrite/rotation inside the run: per-entry path
+            for e in entries:
+                t = self.mt.insert(e)
+                self.wal.write(self.uid, e.index, e.term,
+                               encode_cmd(e.cmd), tid=t)
+            return
+        memo: dict = {}
+        rows = []
+        for e in entries:
+            c = e.cmd
+            enc = memo.get(id(c))
+            if enc is None:
+                memo[id(c)] = enc = encode_cmd(c)
+            rows.append((e.index, e.term, enc, tid))
+        self.wal.write_many(self.uid, rows)
+
     def write(self, entries: Sequence[Entry]) -> None:
         if not entries:
             return
@@ -116,9 +151,7 @@ class Log(LogApi):
             self.wal.truncate_write(self.uid, first)
             self.mt.truncate_from(first)
             self._rewind_to(first - 1)
-        for e in entries:
-            tid = self.mt.insert(e)
-            self.wal.write(self.uid, e.index, e.term, encode_cmd(e.cmd), tid=tid)
+        self._bulk_insert(entries)
         self._last_index = entries[-1].index
         self._last_term = entries[-1].term
 

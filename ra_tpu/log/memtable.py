@@ -76,6 +76,26 @@ class MemTable:
         head.seq = head.seq.add(entry.index)
         return head.tid
 
+    def insert_run(self, entries) -> Optional[int]:
+        """Bulk insert of a strictly-new contiguous ascending run (the
+        leader/steady-follower append path): one visibility-seq update
+        for the whole run instead of per-entry copies. Returns the table
+        id that took the run, or None when the run needs the per-entry
+        path (overwrite of a live index or table rotation) — the caller
+        then loops :meth:`insert`."""
+        head = self._tables[0]
+        first = entries[0].index
+        if (
+            first in head.entries
+            or len(head.entries) + len(entries) > self.max_entries
+        ):
+            return None
+        d = head.entries
+        for e in entries:
+            d[e.index] = e
+        head.seq = head.seq.append_run(first, entries[-1].index)
+        return head.tid
+
     def insert_sparse(self, entry: Entry) -> int:
         """Out-of-order insert for snapshot live entries (no truncation
         semantics)."""
@@ -95,6 +115,30 @@ class MemTable:
         self._gc_tables()
 
     # -- reads -------------------------------------------------------------
+
+    def get_range(self, lo: int, hi: int) -> List[Optional[Entry]]:
+        """Visible entries for ``[lo, hi]`` (None holes) in ONE pass
+        over the table chain: seq RANGE intersections instead of a
+        per-index membership bisect per table — the read hot path for
+        AER construction and the apply loop."""
+        n = hi - lo + 1
+        out: List[Optional[Entry]] = [None] * n
+        remaining = n
+        for t in self._tables:
+            if remaining == 0:
+                break
+            entries = t.entries
+            for rlo, rhi in t.seq.ranges():
+                if rhi < lo or rlo > hi:
+                    continue
+                for i in range(max(rlo, lo), min(rhi, hi) + 1):
+                    k = i - lo
+                    if out[k] is None:
+                        ent = entries.get(i)
+                        if ent is not None:
+                            out[k] = ent
+                            remaining -= 1
+        return out
 
     def get(self, idx: int) -> Optional[Entry]:
         """Visible read: newest table first, truncations respected."""

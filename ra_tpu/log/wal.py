@@ -82,6 +82,11 @@ class Wal:
         os.makedirs(dir, exist_ok=True)
         self.tables = tables
         self.notify = notify
+        # optional bulk channel: called with [(uid, event), ...] once
+        # per batch instead of one notify() per writer (hosts that route
+        # events through a shared lock set this — e.g. a coordinator's
+        # deliver_many)
+        self.notify_many: Optional[Callable[[List[Tuple[str, Any]]], None]] = None
         self.segment_writer = segment_writer
         self.max_size_bytes = max_size_bytes
         self.max_batch_size = max_batch_size
@@ -146,6 +151,19 @@ class Wal:
             if self._closed or self._failed:
                 return False
             self._queue.append(("s" if sparse else "w", uid, idx, term, payload, tid))
+            self._cv.notify()
+        return True
+
+    def write_many(self, uid: str, rows) -> bool:
+        """Queue a contiguous ascending batch of appends for one writer
+        in ONE lock round (the bulk-append hot path). ``rows`` is
+        ``[(idx, term, payload, tid)]``."""
+        with self._cv:
+            if self._closed or self._failed:
+                return False
+            q = self._queue
+            for idx, term, payload, tid in rows:
+                q.append(("w", uid, idx, term, payload, tid))
             self._cv.notify()
         return True
 
@@ -216,26 +234,53 @@ class Wal:
 
     def _write_batch(self, batch: List[Tuple]) -> None:
         # first pass: bookkeeping + record collection; second: framing
-        # (natively when ra_tpu.native built) + one write/fsync
+        # (natively when ra_tpu.native built) + one write/fsync.
+        # Per-(uid, table) index accumulation is BATCH-LEVEL: indexes
+        # collect into plain lists and merge into the file seqs once per
+        # uid — the earlier per-entry Seq union (plus per-entry snapshot
+        # floor lookups) dominated the whole WAL at 10k-group batches.
         records: List[Tuple[int, int, int, int, bytes]] = []
         # (uid, term) -> indexes written in this batch
         written: Dict[Tuple[str, int], List[int]] = {}
         resends: List[Tuple[str, int]] = []
+        # uid -> [last_any_idx, {tid: [idx, ...]}] pending in this batch
+        acc: Dict[str, list] = {}
+        # uid -> [snap_idx, live_indexes-or-None] (one lookup per uid)
+        snap_cache: Dict[str, list] = {}
+
+        def flush_uid(uid: str, info) -> None:
+            per_uid = self._file_seqs.setdefault(uid, {})
+            for t, idxs in info[1].items():
+                cur = per_uid.get(t)
+                add = Seq.from_list(idxs)
+                per_uid[t] = add if cur is None or cur.is_empty() else cur.union(add)
+            info[1] = {}
+
         for kind, uid, idx, term, payload, tid in batch:
             if kind == "t":
+                info = acc.get(uid)
+                if info is not None:
+                    flush_uid(uid, info)
+                    info[0] = idx - 1
                 ref = self._uid_ref(uid, records)
                 records.append((K_TRUNC, ref, idx, 0, b""))
                 self._last_idx[uid] = idx - 1
                 for t, sq in self._file_seqs.get(uid, {}).items():
                     self._file_seqs[uid][t] = sq.limit(idx - 1)
                 continue
-            snap_idx = self.tables.snapshot_index(uid)
-            # drop writes below the snapshot floor (dead indexes); they
-            # still count as durable for the writer's bookkeeping
-            if idx <= snap_idx and idx not in self.tables.live_indexes(uid):
-                written.setdefault((uid, term), []).append(idx)
-                self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
-                continue
+            sc = snap_cache.get(uid)
+            if sc is None:
+                sc = snap_cache[uid] = [self.tables.snapshot_index(uid), None]
+            snap_idx = sc[0]
+            if idx <= snap_idx:
+                # drop writes below the snapshot floor (dead indexes);
+                # they still count as durable for writer bookkeeping
+                if sc[1] is None:
+                    sc[1] = self.tables.live_indexes(uid)
+                if idx not in sc[1]:
+                    written.setdefault((uid, term), []).append(idx)
+                    self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
+                    continue
             if kind != "s":
                 last = self._last_idx.get(uid)
                 # indexes at or below the snapshot are durable-or-dead, so
@@ -249,21 +294,37 @@ class Wal:
                     continue
             ref = self._uid_ref(uid, records)
             records.append((K_SPARSE if kind == "s" else K_ENTRY, ref, idx, term, payload))
-            per_uid = self._file_seqs.setdefault(uid, {})
+            info = acc.get(uid)
+            if info is None:
+                per_uid = self._file_seqs.setdefault(uid, {})
+                last_any = max((sq.last() or 0 for sq in per_uid.values()), default=0)
+                info = acc[uid] = [last_any, {}]
             if kind == "s":
                 # sparse writes never imply truncation of higher indexes
                 self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
-                per_uid[tid] = per_uid.get(tid, Seq.empty()).add(idx)
+                if idx > info[0]:
+                    info[0] = idx
             else:
                 self._last_idx[uid] = idx
-                last_any = max((sq.last() or 0 for sq in per_uid.values()), default=0)
-                if idx <= last_any:
+                if idx <= info[0]:
                     # overwrite rewinds this file's view across ALL
-                    # tables of the uid (superseded entries)
+                    # tables of the uid (superseded entries), including
+                    # indexes still pending in this batch
+                    flush_uid(uid, info)
+                    per_uid = self._file_seqs[uid]
                     for t in list(per_uid):
                         per_uid[t] = per_uid[t].limit(idx - 1)
-                per_uid[tid] = per_uid.get(tid, Seq.empty()).add(idx)
+                info[0] = idx
+            pend = info[1].get(tid)
+            if pend is None:
+                info[1][tid] = [idx]
+            else:
+                pend.append(idx)
             written.setdefault((uid, term), []).append(idx)
+
+        for uid, info in acc.items():
+            if info[1]:
+                flush_uid(uid, info)
 
         if records:
             buf = self._frame(records)
@@ -289,8 +350,16 @@ class Wal:
             self.counter.incr("bytes_written", len(buf))
             self.counter.put("batch_size", len(batch))
             self._bytes += len(buf)
-        for (uid, term), idxs in written.items():
-            self.notify(uid, ("written", term, Seq.from_list(idxs)))
+        if self.notify_many is not None and len(written) > 1:
+            # one transport/lock round for the whole batch's written
+            # events (a 10k-group batch otherwise pays 10k lock rounds)
+            self.notify_many(
+                [(uid, ("written", term, Seq.from_list(idxs)))
+                 for (uid, term), idxs in written.items()]
+            )
+        else:
+            for (uid, term), idxs in written.items():
+                self.notify(uid, ("written", term, Seq.from_list(idxs)))
         for uid, from_idx in resends:
             self.notify(uid, ("resend_write", from_idx))
         if self._bytes >= self.max_size_bytes:

@@ -125,6 +125,11 @@ class AppendEntriesRpc:
     prev_log_term: int
     leader_commit: int
     entries: Tuple[Entry, ...] = ()
+    # leader-computed hint: every entry in this batch is a plain USR
+    # command (no noops/cluster changes). Lets the receiver skip the
+    # per-entry specials/cluster scan on the write hot path; False is
+    # always safe (receiver scans).
+    plain_usr: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

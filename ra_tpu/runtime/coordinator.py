@@ -43,6 +43,7 @@ import pickle
 import random
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -358,6 +359,32 @@ class BatchCoordinator:
         else:
             q.append(msg)
 
+    def deliver_commands(self, names, cmd: Command) -> None:
+        """Bulk ingress for ONE command fanned to many groups (the
+        pipelined-bench shape: one wave = the same no-op command to
+        every group leader). One lock round, no per-message tuples or
+        type dispatch — at 10k groups per wave the generic deliver_many
+        tuple stream was a measurable share of the host path."""
+        if cmd.priority == "low":
+            with self._ingress_cv:
+                for name in names:
+                    self._enqueue_cmd(name, None, cmd)
+                self._ingress_cv.notify()
+            return
+        by = self.by_name
+        with self._ingress_cv:
+            cq = self._cmd_q
+            get = cq.get
+            for name in names:
+                q = get(name)
+                if q is None:
+                    if name not in by:
+                        continue
+                    cq[name] = [cmd]
+                else:
+                    q.append(cmd)
+            self._ingress_cv.notify()
+
     def deliver_many(self, msgs) -> None:
         """Batch ingress: one lock round for many ``(to_sid, msg,
         from_sid)`` triples (unknown group names are dropped, as in
@@ -470,9 +497,23 @@ class BatchCoordinator:
                 g.term = term0
             active = np.zeros(self.P, dtype=bool)
             active[: len(members)] = True
-            li, _ = g.log.last_index_term()
+            li, lt = g.log.last_index_term()
             snap0 = g.log.snapshot_index_term()
-            fi = snap0[0] + 1 if snap0 else 1
+            sidx, sterm = snap0 if snap0 else (0, 0)
+            if snap0 is not None:
+                # cold restart onto a snapshot-bearing log: entries at or
+                # below the floor are gone, so the machine state MUST be
+                # restored from the capture (replay-from-1 would raise on
+                # the missing prefix); apply resumes above the floor
+                got = g.log.read_snapshot()
+                if got is not None:
+                    meta0, state_obj = got
+                    g.machine_state = state_obj
+                    g.effective_machine_version = meta0.machine_version
+                    g.last_applied = meta0.index
+                    g.snap_floor = meta0.index
+                    self._applied_np[gid] = meta0.index
+            fi = sidx + 1
             if li >= fi:
                 # a pre-populated log (cold restart with a persistent
                 # log): seed the specials index so the batched apply
@@ -481,7 +522,8 @@ class BatchCoordinator:
                     e.index for e in g.log.fetch_range(fi, li)
                     if type(e.cmd) is not Command or e.cmd.kind != USR
                 ]
-            rows.append((gid, active, g.self_slot, term0, voted_slot))
+            rows.append((gid, active, g.self_slot, term0, voted_slot,
+                         li, lt, sidx, sterm))
             hosts.append((name, g))
             sids.append(sid)
         if rows:
@@ -490,6 +532,25 @@ class BatchCoordinator:
             slots = jnp.asarray(np.array([r[2] for r in rows], np.int32))
             terms = jnp.asarray(np.array([r[3] for r in rows], np.int32))
             voted = jnp.asarray(np.array([r[4] for r in rows], np.int32))
+            lis_np = np.array([r[5] for r in rows], np.int32)
+            lts_np = np.array([r[6] for r in rows], np.int32)
+            sidx_np = np.array([r[7] for r in rows], np.int32)
+            sterm_np = np.array([r[8] for r in rows], np.int32)
+            lis = jnp.asarray(lis_np)
+            lts = jnp.asarray(lts_np)
+            sidxs = jnp.asarray(sidx_np)
+            sterms = jnp.asarray(sterm_np)
+            # recovered tails: the device learns last/written/snapshot
+            # rows, with the whole (snap, li] interval marked
+            # term-unknown — prev-term lookups fall back to the host log
+            # (needs_host) until traffic reconciles the ring. Everything
+            # already on disk is durable, so written == last.
+            unk_lo = jnp.asarray(
+                np.where(lis_np > sidx_np, sidx_np + 1, 1).astype(np.int32)
+            )
+            unk_hi = jnp.asarray(
+                np.where(lis_np > sidx_np, lis_np, 0).astype(np.int32)
+            )
             with self._state_lock:
                 self.state = self.state._replace(
                     active=self.state.active.at[gids].set(act),
@@ -497,6 +558,15 @@ class BatchCoordinator:
                     self_slot=self.state.self_slot.at[gids].set(slots),
                     current_term=self.state.current_term.at[gids].set(terms),
                     voted_for=self.state.voted_for.at[gids].set(voted),
+                    last_index=self.state.last_index.at[gids].set(lis),
+                    last_term=self.state.last_term.at[gids].set(lts),
+                    written_index=self.state.written_index.at[gids].set(lis),
+                    commit_index=self.state.commit_index.at[gids].set(sidxs),
+                    last_applied=self.state.last_applied.at[gids].set(sidxs),
+                    snapshot_index=self.state.snapshot_index.at[gids].set(sidxs),
+                    snapshot_term=self.state.snapshot_term.at[gids].set(sterms),
+                    unknown_lo=self.state.unknown_lo.at[gids].set(unk_lo),
+                    unknown_hi=self.state.unknown_hi.at[gids].set(unk_hi),
                 )
         # publish only after the device rows are live: deliver() must
         # never accept traffic for a group with inactive rows
@@ -542,6 +612,9 @@ class BatchCoordinator:
         appended: Dict[int, List[List[int]]] = {}
         written: Dict[int, int] = {}
         aer_dirty: set = set()
+        # replies produced during routing (deferred durable acks): one
+        # transport hop per destination per step, not one per group
+        route_out: Dict[str, List] = {}
 
         by_get = self.by_name.get
         route = self._route_one
@@ -549,7 +622,11 @@ class BatchCoordinator:
             g = by_get(to_name)
             if g is None:
                 continue
-            route(g, from_sid, msg, rare, appended, written, aer_dirty)
+            route(g, from_sid, msg, rare, appended, written, aer_dirty,
+                  route_out)
+        if route_out:
+            for node_name, msgs in route_out.items():
+                self._send_batch(node_name, msgs)
         # commands were pre-grouped per target at delivery time
         if cmd_q:
             for name, cmds in cmd_q.items():
@@ -661,7 +738,8 @@ class BatchCoordinator:
 
     # -- ingress routing ---------------------------------------------------
 
-    def _route_one(self, g: GroupHost, from_sid, msg, rare, appended, written, aer_dirty):
+    def _route_one(self, g: GroupHost, from_sid, msg, rare, appended,
+                   written, aer_dirty, route_out):
         if type(msg) is FromPeer:
             from_sid, msg = msg.peer, msg.msg
         t = type(msg)
@@ -714,12 +792,14 @@ class BatchCoordinator:
                 g.pending_ack = None
                 ack = min(wi, cover)
                 at = g.log.fetch_term(ack)
-                self._send_batch(
-                    leader_sid[1],
-                    [(leader_sid,
-                      AppendEntriesReply(g.term, True, ack + 1, ack,
-                                         at if at is not None else wt),
-                      (g.name, self.name))],
+                out = route_out.get(leader_sid[1])
+                if out is None:
+                    route_out[leader_sid[1]] = out = []
+                out.append(
+                    (leader_sid,
+                     AppendEntriesReply(g.term, True, ack + 1, ack,
+                                        at if at is not None else wt),
+                     (g.name, self.name))
                 )
             return
         rare.append((g, msg, from_sid))
@@ -1094,7 +1174,10 @@ class BatchCoordinator:
         outbound: Dict[str, List[Tuple[ServerId, Any, ServerId]]] = {}
 
         def queue_send(to: ServerId, msg: Any, frm: ServerId):
-            outbound.setdefault(to[1], []).append((to, msg, frm))
+            out = outbound.get(to[1])
+            if out is None:
+                outbound[to[1]] = out = []
+            out.append((to, msg, frm))
 
         groups = self.groups
         needs_host = eg["needs_host"]
@@ -1125,7 +1208,7 @@ class BatchCoordinator:
                         # durable watermark, so it builds the success
                         # ack (possibly deferred until WAL fsync)
                         self._host_write_entries(g, msg)
-                        self._ack_aer(g, from_sid, msg, term_l[p], queue_send)
+                        self._ack_aer(g, from_sid, msg, term_l[p], outbound)
                     elif sr_l[p] and from_sid is not None:
                         queue_send(
                             from_sid,
@@ -1291,39 +1374,50 @@ class BatchCoordinator:
             # followers adopt replicated cluster changes at write time
             # (reference: cluster scan on follower writes,
             # src/ra_server.erl:1005-1040) and index every non-USR
-            # entry for the apply fast path
-            specials = g.specials
-            for e in to_write:
-                c = e.cmd
-                if type(c) is not Command:
-                    specials.append(e.index)
-                    continue
-                k = c.kind
-                if k != USR:
-                    specials.append(e.index)
-                    if k in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
-                        self._adopt_cluster_cmd(g, c, e.index)
+            # entry for the apply fast path. A leader-stamped plain_usr
+            # batch skips the scan (the hot pipeline shape).
+            if not msg.plain_usr:
+                specials = g.specials
+                for e in to_write:
+                    c = e.cmd
+                    if type(c) is not Command:
+                        specials.append(e.index)
+                        continue
+                    k = c.kind
+                    if k != USR:
+                        specials.append(e.index)
+                        if k in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
+                            self._adopt_cluster_cmd(g, c, e.index)
             # reconcile the device term ring exactly (clears the
-            # multi-entry unknown interval next step); contiguous
-            # same-term spans collapse to one run row
+            # multi-entry unknown interval next step). Raft log terms
+            # are monotonic, so equal first/last terms mean ONE run —
+            # the per-entry split loop only runs for term-crossing
+            # batches (rare: a new leader resending mixed history)
             pend = self._pending_scatters
-            lo = prev = to_write[0].index
-            term = to_write[0].term
-            for e in to_write[1:]:
-                if e.term != term:
-                    pend.append(("a", g.gid, lo, prev, term))
-                    lo, term = e.index, e.term
-                prev = e.index
-            pend.append(("a", g.gid, lo, prev, term))
+            first = to_write[0]
+            last = to_write[-1]
+            if first.term == last.term:
+                pend.append(("a", g.gid, first.index, last.index, first.term))
+            else:
+                lo = prev = first.index
+                term = first.term
+                for e in to_write[1:]:
+                    if e.term != term:
+                        pend.append(("a", g.gid, lo, prev, term))
+                        lo, term = e.index, e.term
+                    prev = e.index
+                pend.append(("a", g.gid, lo, prev, term))
             wi, _ = g.log.last_written()
             if wi >= to_write[-1].index:
                 pend.append(("w", g.gid, wi))
 
-    def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, queue_send):
+    def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, outbound):
         """Success ack with the host's durable watermark, anchored to
         what THIS AER covered (a shorter-logged new leader must not see
         acks above its own prev — mirrors the scalar backend); deferred
-        until the WAL confirms when the write is still in flight."""
+        until the WAL confirms when the write is still in flight.
+        Appends into the caller's per-destination ``outbound`` map (hot
+        path: one ack per follower group per step)."""
         last_entry = msg.entries[-1].index if msg.entries else msg.prev_log_index
         wi, wt = g.log.last_written()
         if wi >= last_entry:
@@ -1341,12 +1435,15 @@ class BatchCoordinator:
             g.last_ok_sent = (from_sid, term, ack, now)
             # steady state acks exactly at the watermark: reuse its term
             at = wt if ack == wi else g.log.fetch_term(ack)
-            queue_send(
+            out = outbound.get(from_sid[1])
+            if out is None:
+                outbound[from_sid[1]] = out = []
+            out.append((
                 from_sid,
                 AppendEntriesReply(term, True, ack + 1, ack,
                                    at if at is not None else wt),
                 (g.name, self.name),
-            )
+            ))
         else:
             g.pending_ack = (from_sid, last_entry)
 
@@ -1677,10 +1774,26 @@ class BatchCoordinator:
                     ):
                         rpc = self._NEEDS_SNAPSHOT
                     else:
+                        # stamp plain-USR batches so the receiver skips
+                        # its per-entry specials scan. g.specials is
+                        # only exhaustive ABOVE last_applied (older
+                        # rows are pruned), so lagging-peer backfills
+                        # below the applied floor never get the stamp.
+                        plain = False
+                        if entries and nxt > g.last_applied:
+                            sp = g.specials
+                            if not sp:
+                                plain = True
+                            else:
+                                i = bisect_left(sp, nxt)
+                                plain = (
+                                    i >= len(sp)
+                                    or sp[i] > entries[-1].index
+                                )
                         rpc = AppendEntriesRpc(
                             term=g.term, leader_id=sid, prev_log_index=prev_idx,
                             prev_log_term=prev_term, leader_commit=commit,
-                            entries=tuple(entries),
+                            entries=tuple(entries), plain_usr=plain,
                         )
                     rpc_cache[nxt] = rpc
                 if rpc is self._NEEDS_SNAPSHOT:

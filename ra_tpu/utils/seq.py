@@ -119,6 +119,20 @@ class Seq:
                 return Seq(self._ranges[:-1] + [(lo, idx)], _normalized=True)
         return Seq(self._ranges + [(idx, idx)], _normalized=True)
 
+    def append_run(self, lo: int, hi: int) -> "Seq":
+        """Add the contiguous run ``[lo, hi]`` in one step; ``lo`` must
+        be greater than ``last()`` (the bulk-append hot path — one range
+        update instead of hi-lo+1 copies)."""
+        if hi < lo:
+            return self
+        if self._ranges:
+            plo, phi = self._ranges[-1]
+            if lo <= phi:
+                raise ValueError(f"append_run {lo} not greater than last {phi}")
+            if lo == phi + 1:
+                return Seq(self._ranges[:-1] + [(plo, hi)], _normalized=True)
+        return Seq(self._ranges + [(lo, hi)], _normalized=True)
+
     def add(self, idx: int) -> "Seq":
         """Add an arbitrary index (set union with {idx})."""
         if idx in self:
