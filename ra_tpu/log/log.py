@@ -131,14 +131,18 @@ class Log(LogApi):
                                encode_cmd(e.cmd), tid=t)
             return
         memo: dict = {}
-        rows = []
+        payloads = []
+        terms = []
         for e in entries:
             c = e.cmd
             enc = memo.get(id(c))
             if enc is None:
                 memo[id(c)] = enc = encode_cmd(c)
-            rows.append((e.index, e.term, enc, tid))
-        self.wal.write_many(self.uid, rows)
+            payloads.append(enc)
+            terms.append(e.term)
+        # ONE queue item + run-level writer bookkeeping for the whole
+        # contiguous run (the WAL expands it to per-entry frames)
+        self.wal.write_run(self.uid, entries[0].index, terms, payloads, tid)
 
     def write(self, entries: Sequence[Entry]) -> None:
         if not entries:
@@ -267,6 +271,24 @@ class Log(LogApi):
         if self._snapshot_meta is not None and idx == self._snapshot_meta.index:
             return self._snapshot_meta.term
         return None
+
+    def fetch_range(self, lo: int, hi: int) -> List[Entry]:
+        """Batched contiguous read (the AER-construction / apply hot
+        path): ONE memtable chain pass for the whole range instead of a
+        per-index table walk, segment fallback only for flushed holes.
+        Stops at the first truly-missing index (base-class contract)."""
+        if hi < lo:
+            return []
+        got = self.mt.get_range(lo, hi)
+        out: List[Entry] = []
+        segs_fetch = self.segs.fetch
+        for k, e in enumerate(got):
+            if e is None:
+                e = segs_fetch(lo + k)
+                if e is None:
+                    break
+            out.append(e)
+        return out
 
     def fold(self, lo: int, hi: int, fn: Callable[[Entry, Any], Any], acc: Any) -> Any:
         for i in range(lo, hi + 1):

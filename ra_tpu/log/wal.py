@@ -55,6 +55,10 @@ K_UID = 1
 K_ENTRY = 2
 K_TRUNC = 3
 K_SPARSE = 4  # entry layout; no gap/truncate semantics on recovery
+# in-memory record marker for a contiguous same-writer run; expanded to
+# per-entry K_ENTRY frames at framing time (never written to disk).
+# Value mirrored in ra_tpu/native/__init__.py.
+K_RUN = 100
 
 _ENTRY_HDR = struct.Struct("<BHQQII")
 _UID_HDR = struct.Struct("<BHH")
@@ -154,16 +158,16 @@ class Wal:
             self._cv.notify()
         return True
 
-    def write_many(self, uid: str, rows) -> bool:
-        """Queue a contiguous ascending batch of appends for one writer
-        in ONE lock round (the bulk-append hot path). ``rows`` is
-        ``[(idx, term, payload, tid)]``."""
+    def write_run(self, uid: str, first: int, terms, payloads, tid: int = 0) -> bool:
+        """Queue a contiguous ascending run of appends as ONE queue item
+        (the pipelined hot path: the writer loop does run-level — not
+        per-entry — bookkeeping, and framing expands the run natively).
+        ``terms[k]``/``payloads[k]`` belong to index ``first + k``; all
+        entries live in memtable table ``tid``."""
         with self._cv:
             if self._closed or self._failed:
                 return False
-            q = self._queue
-            for idx, term, payload, tid in rows:
-                q.append(("w", uid, idx, term, payload, tid))
+            self._queue.append(("r", uid, first, terms, payloads, tid))
             self._cv.notify()
         return True
 
@@ -235,42 +239,58 @@ class Wal:
     def _write_batch(self, batch: List[Tuple]) -> None:
         # first pass: bookkeeping + record collection; second: framing
         # (natively when ra_tpu.native built) + one write/fsync.
-        # Per-(uid, table) index accumulation is BATCH-LEVEL: indexes
-        # collect into plain lists and merge into the file seqs once per
-        # uid — the earlier per-entry Seq union (plus per-entry snapshot
-        # floor lookups) dominated the whole WAL at 10k-group batches.
-        records: List[Tuple[int, int, int, int, bytes]] = []
-        # (uid, term) -> indexes written in this batch
-        written: Dict[Tuple[str, int], List[int]] = {}
+        # Per-(uid, table) index accumulation is BATCH-LEVEL and
+        # RUN-LEVEL: indexes collect into (lo, hi) pair lists and merge
+        # into the file seqs once per uid — per-entry Seq unions (plus
+        # per-entry snapshot floor lookups) dominated the whole WAL at
+        # 10k-group batches, and "r" run items process a whole
+        # contiguous append run with O(1) bookkeeping.
+        records: List[Tuple] = []
+        # (uid, term) -> (lo, hi) pairs written in this batch
+        written: Dict[Tuple[str, int], List[Tuple[int, int]]] = {}
         resends: List[Tuple[str, int]] = []
-        # uid -> [last_any_idx, {tid: [idx, ...]}] pending in this batch
+        # uid -> [last_any_idx, {tid: [(lo, hi), ...]}] pending in batch
         acc: Dict[str, list] = {}
         # uid -> [snap_idx, live_indexes-or-None] (one lookup per uid)
         snap_cache: Dict[str, list] = {}
+        n_entries = 0
 
         def flush_uid(uid: str, info) -> None:
             per_uid = self._file_seqs.setdefault(uid, {})
-            for t, idxs in info[1].items():
+            for t, pairs in info[1].items():
                 cur = per_uid.get(t)
-                add = Seq.from_list(idxs)
+                add = Seq(pairs)
                 per_uid[t] = add if cur is None or cur.is_empty() else cur.union(add)
             info[1] = {}
 
-        for kind, uid, idx, term, payload, tid in batch:
-            if kind == "t":
-                info = acc.get(uid)
-                if info is not None:
-                    flush_uid(uid, info)
-                    info[0] = idx - 1
-                ref = self._uid_ref(uid, records)
-                records.append((K_TRUNC, ref, idx, 0, b""))
-                self._last_idx[uid] = idx - 1
-                for t, sq in self._file_seqs.get(uid, {}).items():
-                    self._file_seqs[uid][t] = sq.limit(idx - 1)
-                continue
+        def get_info(uid: str):
+            info = acc.get(uid)
+            if info is None:
+                per_uid = self._file_seqs.setdefault(uid, {})
+                last_any = max((sq.last() or 0 for sq in per_uid.values()), default=0)
+                info = acc[uid] = [last_any, {}]
+            return info
+
+        def get_snap(uid: str):
             sc = snap_cache.get(uid)
             if sc is None:
                 sc = snap_cache[uid] = [self.tables.snapshot_index(uid), None]
+            return sc
+
+        def note_pair(pairs_by_key, key, lo: int, hi: int) -> None:
+            pend = pairs_by_key.get(key)
+            if pend is None:
+                pairs_by_key[key] = [(lo, hi)]
+            else:
+                tlo, thi = pend[-1]
+                if thi + 1 == lo:
+                    pend[-1] = (tlo, hi)
+                else:
+                    pend.append((lo, hi))
+
+        def one(kind, uid, idx, term, payload, tid) -> None:
+            nonlocal n_entries
+            sc = get_snap(uid)
             snap_idx = sc[0]
             if idx <= snap_idx:
                 # drop writes below the snapshot floor (dead indexes);
@@ -278,9 +298,9 @@ class Wal:
                 if sc[1] is None:
                     sc[1] = self.tables.live_indexes(uid)
                 if idx not in sc[1]:
-                    written.setdefault((uid, term), []).append(idx)
+                    note_pair(written, (uid, term), idx, idx)
                     self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
-                    continue
+                    return
             if kind != "s":
                 last = self._last_idx.get(uid)
                 # indexes at or below the snapshot are durable-or-dead, so
@@ -291,14 +311,11 @@ class Wal:
                     # order
                     self.counter.incr("out_of_seq")
                     resends.append((uid, max(last, snap_idx) + 1))
-                    continue
+                    return
             ref = self._uid_ref(uid, records)
             records.append((K_SPARSE if kind == "s" else K_ENTRY, ref, idx, term, payload))
-            info = acc.get(uid)
-            if info is None:
-                per_uid = self._file_seqs.setdefault(uid, {})
-                last_any = max((sq.last() or 0 for sq in per_uid.values()), default=0)
-                info = acc[uid] = [last_any, {}]
+            n_entries += 1
+            info = get_info(uid)
             if kind == "s":
                 # sparse writes never imply truncation of higher indexes
                 self._last_idx[uid] = max(self._last_idx.get(uid, 0), idx)
@@ -315,12 +332,62 @@ class Wal:
                     for t in list(per_uid):
                         per_uid[t] = per_uid[t].limit(idx - 1)
                 info[0] = idx
-            pend = info[1].get(tid)
-            if pend is None:
-                info[1][tid] = [idx]
+            note_pair(info[1], tid, idx, idx)
+            note_pair(written, (uid, term), idx, idx)
+
+        for item in batch:
+            kind = item[0]
+            if kind == "r":
+                _, uid, first, terms, payloads, tid = item
+                m = len(payloads)
+                snap_idx = get_snap(uid)[0]
+                if first <= snap_idx:
+                    # run overlaps the snapshot floor (rare): per-entry
+                    # path keeps the dead-index filtering exact
+                    for k in range(m):
+                        one("w", uid, first + k, terms[k], payloads[k], tid)
+                    continue
+                last = self._last_idx.get(uid)
+                if last is not None and first > max(last, snap_idx) + 1:
+                    self.counter.incr("out_of_seq")
+                    resends.append((uid, max(last, snap_idx) + 1))
+                    continue
+                last_e = first + m - 1
+                ref = self._uid_ref(uid, records)
+                records.append((K_RUN, ref, first, terms, payloads))
+                n_entries += m
+                info = get_info(uid)
+                self._last_idx[uid] = last_e
+                if first <= info[0]:
+                    flush_uid(uid, info)
+                    per_uid = self._file_seqs[uid]
+                    for t in list(per_uid):
+                        per_uid[t] = per_uid[t].limit(first - 1)
+                info[0] = last_e
+                note_pair(info[1], tid, first, last_e)
+                # written events key on (uid, term): split multi-term runs
+                if terms[0] == terms[-1]:
+                    note_pair(written, (uid, terms[0]), first, last_e)
+                else:
+                    lo, t0 = first, terms[0]
+                    for k in range(1, m):
+                        if terms[k] != t0:
+                            note_pair(written, (uid, t0), lo, first + k - 1)
+                            lo, t0 = first + k, terms[k]
+                    note_pair(written, (uid, t0), lo, last_e)
+            elif kind == "t":
+                _, uid, idx, _term, _payload, _tid = item
+                info = acc.get(uid)
+                if info is not None:
+                    flush_uid(uid, info)
+                    info[0] = idx - 1
+                ref = self._uid_ref(uid, records)
+                records.append((K_TRUNC, ref, idx, 0, b""))
+                self._last_idx[uid] = idx - 1
+                for t, sq in self._file_seqs.get(uid, {}).items():
+                    self._file_seqs[uid][t] = sq.limit(idx - 1)
             else:
-                pend.append(idx)
-            written.setdefault((uid, term), []).append(idx)
+                one(kind, item[1], item[2], item[3], item[4], item[5])
 
         for uid, info in acc.items():
             if info[1]:
@@ -346,20 +413,20 @@ class Wal:
                 self._fail(err)
                 return
             self.counter.incr("batches")
-            self.counter.incr("writes", len(batch))
+            self.counter.incr("writes", n_entries)
             self.counter.incr("bytes_written", len(buf))
-            self.counter.put("batch_size", len(batch))
+            self.counter.put("batch_size", n_entries)
             self._bytes += len(buf)
         if self.notify_many is not None and len(written) > 1:
             # one transport/lock round for the whole batch's written
             # events (a 10k-group batch otherwise pays 10k lock rounds)
             self.notify_many(
-                [(uid, ("written", term, Seq.from_list(idxs)))
-                 for (uid, term), idxs in written.items()]
+                [(uid, ("written", term, Seq(pairs)))
+                 for (uid, term), pairs in written.items()]
             )
         else:
-            for (uid, term), idxs in written.items():
-                self.notify(uid, ("written", term, Seq.from_list(idxs)))
+            for (uid, term), pairs in written.items():
+                self.notify(uid, ("written", term, Seq(pairs)))
         for uid, from_idx in resends:
             self.notify(uid, ("resend_write", from_idx))
         if self._bytes >= self.max_size_bytes:
@@ -394,13 +461,29 @@ class Wal:
                 return out
             self._native = False  # build failed: stay on the fallback
         buf = bytearray()
-        for kind, ref, idx, term, payload in records:
+        for rec in records:
+            kind = rec[0]
             if kind == K_UID:
+                _, ref, _idx, _term, payload = rec
                 buf += _UID_HDR.pack(K_UID, ref, len(payload))
                 buf += payload
             elif kind == K_TRUNC:
-                buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
+                buf += _TRUNC_HDR.pack(K_TRUNC, ref, rec[2])
+            elif kind == K_RUN:
+                # expand to per-entry frames (disk format is unchanged)
+                _, ref, first, terms, payloads = rec
+                for k, payload in enumerate(payloads):
+                    idx, term = first + k, terms[k]
+                    crc = (
+                        zlib.crc32(struct.pack("<QQ", idx, term) + payload)
+                        if self.compute_checksums
+                        else 0
+                    )
+                    buf += _ENTRY_HDR.pack(K_ENTRY, ref, idx, term, crc,
+                                           len(payload))
+                    buf += payload
             else:  # K_ENTRY / K_SPARSE share the layout
+                _, ref, idx, term, payload = rec
                 crc = (
                     zlib.crc32(struct.pack("<QQ", idx, term) + payload)
                     if self.compute_checksums

@@ -79,8 +79,12 @@ def available() -> bool:
     return _load() is not None
 
 
-# record: (kind:int, ref:int, idx:int, term:int, payload:bytes)
+# record: (kind:int, ref:int, idx:int, term:int, payload:bytes), or a
+# contiguous run (K_RUN, ref, first_idx, terms_list, payloads_list) that
+# expands to per-entry K_ENTRY frames (mirrors ra_tpu.log.wal.K_RUN)
 Record = Tuple[int, int, int, int, bytes]
+K_RUN = 100
+_K_ENTRY = 2
 
 
 def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[bytes]:
@@ -88,24 +92,44 @@ def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[byt
     lib = _load()
     if lib is None or not records:
         return None if lib is None else b""
-    n = len(records)
+    n = 0
+    for r in records:
+        n += len(r[4]) if r[0] == K_RUN else 1
     kinds = np.empty(n, np.uint8)
     refs = np.empty(n, np.uint16)
     idxs = np.empty(n, np.uint64)
     terms = np.empty(n, np.uint64)
-    offs = np.empty(n, np.uint64)
     lens = np.empty(n, np.uint32)
     parts = []
-    off = 0
-    for i, (kind, ref, idx, term, payload) in enumerate(records):
-        kinds[i] = kind
-        refs[i] = ref
-        idxs[i] = idx
-        terms[i] = term
-        offs[i] = off
-        lens[i] = len(payload)
-        parts.append(payload)
-        off += len(payload)
+    i = 0
+    for rec in records:
+        kind = rec[0]
+        if kind == K_RUN:
+            # vectorized fill for the whole run — one Python round per
+            # contiguous append run instead of one per entry
+            _, ref, first, run_terms, payloads = rec
+            m = len(payloads)
+            sl = slice(i, i + m)
+            kinds[sl] = _K_ENTRY
+            refs[sl] = ref
+            idxs[sl] = np.arange(first, first + m, dtype=np.uint64)
+            terms[sl] = run_terms
+            lens[sl] = [len(p) for p in payloads]
+            parts.extend(payloads)
+            i += m
+        else:
+            _, ref, idx, term, payload = rec
+            kinds[i] = kind
+            refs[i] = ref
+            idxs[i] = idx
+            terms[i] = term
+            lens[i] = len(payload)
+            parts.append(payload)
+            i += 1
+    offs = np.empty(n, np.uint64)
+    if n:
+        offs[0] = 0
+        np.cumsum(lens[:-1], dtype=np.uint64, out=offs[1:])
     blob = b"".join(parts)
     bound = lib.wal_frame_bound(
         kinds.ctypes.data_as(ctypes.c_char_p), lens.ctypes.data, n
