@@ -212,16 +212,51 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # fixed sample of groups for the commit-latency distribution
         sample = np.arange(0, groups, max(1, groups // 256), dtype=np.int64)
 
-        def run_wave(n_waves: int) -> None:
+        def run_wave(n_waves: int, loaded_lats: list = None) -> None:
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
+            wave_t: list = []
+            base0 = base[sample].copy()
             for _ in range(n_waves):
                 base.__iadd__(1)
+                wave_t.append(time.perf_counter())
                 coords[0].deliver_commands(names, cmd)
+            # per-sample pointer into wave_t: how many waves this sampled
+            # group has fully applied (loaded-latency bookkeeping)
+            done_w = np.zeros(len(sample), np.int64)
             while time.time() < deadline:
                 step_all()
+                if loaded_lats is not None:
+                    now = time.perf_counter()
+                    newly = np.minimum(
+                        coords[0]._applied_np[sample] - base0, n_waves
+                    )
+                    for s in np.flatnonzero(newly > done_w):
+                        for k in range(done_w[s], newly[s]):
+                            loaded_lats.append(now - wave_t[k])
+                        done_w[s] = newly[s]
                 if all((c._applied_np[:groups] >= base).all() for c in coords):
                     return
             raise TimeoutError("wave did not complete")
+
+        def drain_storage(timeout_s: float = 120.0) -> None:
+            """Wait for the WALs/segment writers to digest any backlog so
+            the unloaded-latency phase measures commit latency, not
+            competition with the bench's own earlier traffic."""
+            end = time.time() + timeout_s
+            while time.time() < end:
+                while step_all():
+                    pass
+                if all(
+                    not w._queue and sw.wait_idle(timeout=0.0)
+                    for _t, w, sw, _d, _b in storage
+                ):
+                    return
+                time.sleep(0.01)
+
+        # the cooperative spin loop shares ONE core with the WAL fsync
+        # threads; the default 5 ms GIL switch interval would dominate
+        # every commit round trip (each fsync handoff pays it)
+        sys.setswitchinterval(0.0002)
 
         def latency_phase(n_waves: int) -> list:
             """p50/p99 commit latency: the sampled groups (256 of them)
@@ -229,8 +264,11 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             latency = delivery -> leader apply per sampled group. This
             is the unloaded commit round trip (append, replicate, fsync
             on three logs, quorum, apply) — the reference's
-            commit-latency gauge measures the same thing per entry; the
-            throughput passes above measure saturation separately."""
+            commit-latency gauge measures the same thing per entry. It
+            runs BEFORE the saturation passes (after a storage drain):
+            measuring it after them would time the segment writers
+            digesting the passes' backlog, not commit latency. The
+            passes report their own LOADED latency distribution."""
             lats: list = []
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             sample_names = [f"g{g}" for g in sample]
@@ -240,7 +278,10 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 t0 = time.perf_counter()
                 coords[0].deliver_commands(sample_names, cmd)
                 while time.time() < deadline:
-                    step_all()
+                    if not step_all():
+                        # idle: the round trip is waiting on a WAL
+                        # fsync thread — hand it the core immediately
+                        time.sleep(0)
                     now = time.perf_counter()
                     newly = ~done & (coords[0]._applied_np[sample] >= base[sample])
                     if newly.any():
@@ -254,9 +295,21 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
 
         try:
             run_wave(1)  # warmup: compiles remaining scatter/step shapes
+            latency_phase(1)  # warm the active-set sub-batch shapes
         except TimeoutError:
             print("bench error: warmup wave incomplete", file=sys.stderr)
             _retry_on_cpu_or_fail()
+
+        # unloaded commit latency FIRST (quiesced storage, idle fleet)
+        if wal:
+            drain_storage()
+        try:
+            lats = latency_phase(8)
+        except TimeoutError:
+            print("bench error: latency phase incomplete", file=sys.stderr)
+            _retry_on_cpu_or_fail()
+        p50 = float(np.percentile(lats, 50) * 1000)
+        p99 = float(np.percentile(lats, 99) * 1000)
 
         # best-of-3 measured passes: the rate measures framework
         # capability, and a single pass on a shared 1-core host is at
@@ -264,11 +317,16 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # every group's full end-to-end state)
         total = groups * cmds
         best = 0.0
+        loaded: list = []
         for _pass in range(3):
-            state0 = coords[0].by_name["g0"].machine_state
+            # per-group baselines: the latency warmup advances only the
+            # sampled groups, so states are not uniform across groups
+            state0 = [
+                coords[0].by_name[f"g{g}"].machine_state for g in range(groups)
+            ]
             t0 = time.perf_counter()
             try:
-                run_wave(cmds)
+                run_wave(cmds, loaded_lats=loaded)
             except TimeoutError:
                 if best > 0:
                     # a fully verified earlier pass already produced a
@@ -278,7 +336,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                           "completed pass", file=sys.stderr)
                     break
                 done = sum(
-                    coords[0].by_name[f"g{g}"].machine_state - state0 == cmds
+                    coords[0].by_name[f"g{g}"].machine_state - state0[g] == cmds
                     for g in range(groups)
                 )
                 print(
@@ -288,7 +346,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 _retry_on_cpu_or_fail()
             dt = time.perf_counter() - t0
             bad = sum(
-                coords[0].by_name[f"g{g}"].machine_state - state0 != cmds
+                coords[0].by_name[f"g{g}"].machine_state - state0[g] != cmds
                 for g in range(groups)
             )
             if bad:
@@ -297,27 +355,22 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 _retry_on_cpu_or_fail()
             best = max(best, total / dt)
 
-        try:
-            lats = latency_phase(8)
-        except TimeoutError:
-            print("bench error: latency phase incomplete", file=sys.stderr)
-            _retry_on_cpu_or_fail()
-        p50 = float(np.percentile(lats, 50) * 1000)
-        p99 = float(np.percentile(lats, 99) * 1000)
-
         return {
             "metric": (
                 f"durable replicated commands/sec ({groups} groups x 3 "
                 f"replicas, {'shared-WAL fsync-gated logs' if wal else 'in-memory logs (routing ceiling)'}, "
                 f"tpu_batch coordinators, device {jax.devices()[0].platform}, "
-                f"best of 3 passes; p50/p99 = unloaded commit latency over "
-                f"{len(sample)} sampled groups)"
+                f"best of 3 passes; p50/p99 = unloaded commit latency, "
+                f"loaded_p50/p99 = delivery->apply under the pipelined "
+                f"saturation load, both over {len(sample)} sampled groups)"
             ),
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
             "p50_ms": round(p50, 2),
             "p99_ms": round(p99, 2),
+            "loaded_p50_ms": round(float(np.percentile(loaded, 50) * 1000), 2),
+            "loaded_p99_ms": round(float(np.percentile(loaded, 99) * 1000), 2),
         }
     finally:
         for c in coords:

@@ -1,0 +1,36 @@
+"""Profile one WAL-backed pipelined run (dev tool, not shipped API).
+
+Usage: PYTHONPATH= JAX_PLATFORMS=cpu python profile_wave.py [groups] [cmds]
+"""
+import cProfile
+import io
+import pstats
+import sys
+import time
+
+sys.argv = [sys.argv[0]]  # bench's argparse must not see ours
+
+
+def main(groups=2048, cmds=24):
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from bench import bench_pipeline
+
+    t0 = time.perf_counter()
+    pr = cProfile.Profile()
+    pr.enable()
+    out = bench_pipeline(groups, cmds, wal=True)
+    pr.disable()
+    dt = time.perf_counter() - t0
+    print(f"\ntotal wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
+          f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms", file=sys.stderr)
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue(), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    g = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    c = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    main(g, c)

@@ -62,6 +62,7 @@ def configure(quorum_backend: str = None) -> None:
         _QUORUM_BACKEND = quorum_backend
         consensus_step.clear_cache()
         consensus_step_packed.clear_cache()
+        consensus_step_packed_sub.clear_cache()
 
 
 # roles
@@ -593,6 +594,36 @@ def _consensus_step_packed_impl(state: GroupState, packed: jax.Array):
 
 
 consensus_step_packed = jax.jit(_consensus_step_packed_impl, donate_argnums=(0,))
+
+
+def _consensus_step_packed_sub_impl(
+    state: GroupState, packed: jax.Array, gidx: jax.Array
+):
+    """Active-set step: gather ONLY the rows named by ``gidx`` (an i32
+    vector padded to a power of two with out-of-range ids), run the
+    fused step over the compact sub-batch, scatter results back. Step
+    cost scales with *activity*, not capacity — the batch backend's
+    analog of the reference's per-group process waking only on messages
+    (reference: src/ra_server_proc.erl:457-530). Pad rows gather a
+    clamped row's state but their writes are dropped on the scatter, so
+    they cannot perturb any real group."""
+    sub = jax.tree.map(lambda a: a[gidx], state)
+    rows = {name: packed[i] for i, name in enumerate(MBOX_FIELDS)}
+    rows["success"] = rows["success"] != 0
+    mbox = Mailbox(**rows)
+    sub_new, eg = consensus_step_impl(sub, mbox)
+    out = jnp.stack(
+        [getattr(eg, name).astype(jnp.int32) for name in EGRESS_FIELDS]
+    )
+    new_state = jax.tree.map(
+        lambda full, s: full.at[gidx].set(s, mode="drop"), state, sub_new
+    )
+    return new_state, out
+
+
+consensus_step_packed_sub = jax.jit(
+    _consensus_step_packed_sub_impl, donate_argnums=(0,)
+)
 
 
 # ---------------------------------------------------------------------------

@@ -113,7 +113,7 @@ class GroupHost:
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
-        "specials", "last_ok_sent",
+        "specials", "last_ok_sent", "fresh_tail",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -205,6 +205,11 @@ class GroupHost:
         # bound keeps the leader's silent-peer resync probe honest: a
         # probe after 2 quiet ticks always gets a fresh ack.
         self.last_ok_sent: Optional[Tuple[ServerId, int, int, float]] = None
+        # entries appended by THIS step's _handle_commands, passed
+        # through to _send_aers so the steady-state AER build skips the
+        # log re-read: (first_idx, prev_term, term, [Entry, ...]).
+        # Valid only within one step; _send_aers always clears it.
+        self.fresh_tail: Optional[Tuple[int, int, int, list]] = None
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -236,6 +241,7 @@ class BatchCoordinator:
         tick_interval_s: float = 1.0,
         send_msg_cb=None,
         mesh=None,
+        active_set: str = "auto",
     ):
         self.name = node_name
         self.capacity = capacity
@@ -246,6 +252,18 @@ class BatchCoordinator:
         self.idle_sleep_s = idle_sleep_s
         self.tick_interval_s = tick_interval_s
         self.send_msg_cb = send_msg_cb
+        # activity-scaled stepping: "auto" runs the fused step over a
+        # compact gather of just the groups with pending device work
+        # whenever they number at most capacity/4 (power-of-two padded
+        # sub-batches), falling back to the full-width step at
+        # saturation; "always"/"never" pin a path (tests/bench). Step
+        # cost then scales with ACTIVITY, not capacity — a lone commit
+        # round trip at 10k-group capacity no longer pays ~10 full-width
+        # steps (the reference's per-group process wakes only on
+        # messages: src/ra_server_proc.erl:457-530).
+        if active_set not in ("auto", "always", "never"):
+            raise ValueError(f"unknown active_set mode {active_set!r}")
+        self.active_set = active_set
 
         self.state = C.make_group_state(capacity, num_peers, suffix_k)
         # groups not yet registered must never act: mark inactive
@@ -682,21 +700,45 @@ class BatchCoordinator:
             gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written.items()])
             self.state = C.record_written(self.state, gids, idxs)
 
-        packed, consumed = self._build_mailbox()
-        if self._shard_state is not None:
-            # re-pin before the fused step so it executes SPMD over the
-            # mesh (no-op when the layout is already right)
-            self.state = jax.device_put(self.state, self._shard_state)
-            packed = jax.device_put(packed, self._shard_mbox)
-        self.state, eg_packed = C.consensus_step_packed(self.state, packed)
-        eg_np = np.asarray(eg_packed)
-        # egress is host-synced: the device has fully consumed the
-        # mailbox view, so the pack buffer may be reused
-        self._mbox_in_flight = False
-        eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
-        self.steps += 1
-        self.msgs_processed += len(consumed)
-        self._process_egress(eg, consumed, aer_dirty)
+        # activity-scaled path selection: groups with device-relevant
+        # work this step are exactly the hot set (queued messages/term
+        # hints) plus those whose log tail or durable watermark moved
+        # (the quorum scan can advance their commit). Everything else
+        # is provably unchanged by an empty-mailbox step.
+        act: Optional[list] = None
+        if self._shard_state is None and self.active_set != "never":
+            cand = self._hot | appended.keys() | written.keys()
+            if self.active_set == "always" or len(cand) <= (self.capacity >> 2):
+                act = sorted(cand)
+        if act is not None:
+            if act:
+                packed, gidx, act_np, consumed = self._build_mailbox_sub(act)
+                self.state, eg_packed = C.consensus_step_packed_sub(
+                    self.state, packed, gidx
+                )
+                eg_np = np.asarray(eg_packed)
+                eg = {
+                    name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)
+                }
+                self.steps += 1
+                self.msgs_processed += len(consumed)
+                self._process_egress(eg, consumed, aer_dirty, act=act_np)
+        else:
+            packed, consumed = self._build_mailbox()
+            if self._shard_state is not None:
+                # re-pin before the fused step so it executes SPMD over
+                # the mesh (no-op when the layout is already right)
+                self.state = jax.device_put(self.state, self._shard_state)
+                packed = jax.device_put(packed, self._shard_mbox)
+            self.state, eg_packed = C.consensus_step_packed(self.state, packed)
+            eg_np = np.asarray(eg_packed)
+            # egress is host-synced: the device has fully consumed the
+            # mailbox view, so the pack buffer may be reused
+            self._mbox_in_flight = False
+            eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
+            self.steps += 1
+            self.msgs_processed += len(consumed)
+            self._process_egress(eg, consumed, aer_dirty)
 
         for g, msg, from_sid in rare:
             # crash isolation for the slow paths (snapshot transfer
@@ -881,10 +923,15 @@ class BatchCoordinator:
                 simple = False
                 break
         if simple:
-            log.append_many(
-                [Entry(first + k, term, cmd) for k, cmd in enumerate(cmds)]
-            )
+            entries = [Entry(first + k, term, cmd) for k, cmd in enumerate(cmds)]
+            _li, prev_term = log.last_index_term()
+            log.append_many(entries)
             idx = first + len(cmds)
+            ft = g.fresh_tail
+            if ft is not None and ft[0] + len(ft[3]) == first and ft[2] == term:
+                ft[3].extend(entries)  # second batch this step: one run
+            else:
+                g.fresh_tail = (first, prev_term, term, entries)
         else:
             for cmd in cmds:
                 if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
@@ -1127,6 +1174,87 @@ class BatchCoordinator:
             packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
         return jnp.asarray(packed), consumed
 
+    def _build_mailbox_sub(self, act):
+        """Compact mailbox for the active-set step: one COLUMN PER
+        ACTIVE GROUP (power-of-two padded), plus the gather index vector
+        mapping column -> group id. ``consumed`` is keyed by column
+        position (the egress arrays come back in the same position
+        space). Same pop-one-message-per-group semantics as the
+        full-width builder."""
+        n = len(act)
+        # pad floor bounds the number of compiled shapes (straggler
+        # tails would otherwise walk every power of two down to 1)
+        cap = min(256, self.capacity)
+        while cap < n:
+            cap <<= 1
+        packed = np.zeros((len(C.MBOX_FIELDS), cap), np.int32)
+        R = self._R
+        packed[R["host_term_idx"]].fill(-1)
+        packed[R["host_term_val"]].fill(-1)
+        gidx = np.full(cap, self.capacity, np.int32)  # pads dropped on scatter
+        gidx[:n] = act
+        self._hot = set()
+        consumed: Dict[int, Tuple[Any, Any]] = {}
+        groups = self.groups
+        aer_i: List[int] = []
+        aer_m: List[AppendEntriesRpc] = []
+        aer_s: List[int] = []
+        rep_i: List[int] = []
+        rep_m: List[AppendEntriesReply] = []
+        rep_s: List[int] = []
+        for p, i in enumerate(act):
+            g = groups[i]
+            if g is None:
+                continue
+            if g.host_term_hint is not None:
+                packed[R["host_term_idx"], p] = g.host_term_hint[0]
+                packed[R["host_term_val"], p] = g.host_term_hint[1]
+                g.host_term_hint = None
+            if not g.inbox:
+                continue
+            from_sid, msg = g.inbox.popleft()
+            consumed[p] = (from_sid, msg)
+            t = type(msg)
+            if t is AppendEntriesRpc:
+                aer_i.append(p)
+                aer_m.append(msg)
+                aer_s.append(g.slot_of(from_sid) if from_sid else 0)
+            elif t is AppendEntriesReply:
+                rep_i.append(p)
+                rep_m.append(msg)
+                rep_s.append(g.slot_of(from_sid) if from_sid else 0)
+            else:
+                self._encode(g, from_sid, msg, packed, p)
+            if g.inbox:
+                self._hot.add(i)  # more queued: stay hot for next step
+        if rep_i:
+            ii = np.asarray(rep_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER_REPLY
+            packed[R["sender_slot"], ii] = rep_s
+            packed[R["term"], ii] = [m.term for m in rep_m]
+            packed[R["success"], ii] = [1 if m.success else 0 for m in rep_m]
+            packed[R["reply_next_idx"], ii] = [m.next_index for m in rep_m]
+            packed[R["reply_last_idx"], ii] = [m.last_index for m in rep_m]
+            packed[R["reply_last_term"], ii] = [m.last_term for m in rep_m]
+        if aer_i:
+            ii = np.asarray(aer_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER
+            packed[R["sender_slot"], ii] = aer_s
+            packed[R["term"], ii] = [m.term for m in aer_m]
+            packed[R["prev_idx"], ii] = [m.prev_log_index for m in aer_m]
+            packed[R["prev_term"], ii] = [m.prev_log_term for m in aer_m]
+            packed[R["num_entries"], ii] = [len(m.entries) for m in aer_m]
+            packed[R["entries_last_term"], ii] = [
+                m.entries[-1].term if m.entries else 0 for m in aer_m
+            ]
+            packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
+        return (
+            jnp.asarray(packed),
+            jnp.asarray(gidx),
+            np.asarray(act, np.int64),
+            consumed,
+        )
+
     def _encode(self, g: GroupHost, from_sid, msg, p, i) -> None:
         R = self._R
         p[R["sender_slot"], i] = g.slot_of(from_sid) if from_sid else 0
@@ -1170,7 +1298,11 @@ class BatchCoordinator:
 
     # -- egress ------------------------------------------------------------
 
-    def _process_egress(self, eg, consumed, aer_dirty) -> None:
+    def _process_egress(self, eg, consumed, aer_dirty, act=None) -> None:
+        """Realise one step's egress. ``act`` is None for the full-width
+        step (egress row == group id) or the i64 position->gid map of an
+        active-set step (egress row == position in ``act``); ``consumed``
+        is keyed in the same space as the egress rows."""
         outbound: Dict[str, List[Tuple[ServerId, Any, ServerId]]] = {}
 
         def queue_send(to: ServerId, msg: Any, frm: ServerId):
@@ -1196,7 +1328,7 @@ class BatchCoordinator:
             li_l = eg["last_index"][ci].tolist()
             lt_l = eg["last_term"][ci].tolist()
             for p, (i, (from_sid, msg)) in enumerate(items):
-                g = groups[i]
+                g = groups[i if act is None else act[i]]
                 if g is None:
                     continue
                 t = type(msg)
@@ -1233,8 +1365,10 @@ class BatchCoordinator:
                         )
 
         # vectorized change detection: only touched groups pay Python cost
-        n = self.n_groups
-        applied = self._applied_np[:n]
+        n = self.n_groups if act is None else len(act)
+        applied = (
+            self._applied_np[:n] if act is None else self._applied_np[act]
+        )
         interesting = np.flatnonzero(
             eg["became_candidate"][:n]
             | eg["became_leader"][:n]
@@ -1259,7 +1393,8 @@ class BatchCoordinator:
             nh2_l = needs_host[ti].tolist()
             ag_l = eg["agreed_idx"][ti].tolist()
             now_roles = time.monotonic()
-            for p, i in enumerate(touched):
+            for p, pos in enumerate(touched):
+                i = pos if act is None else int(act[pos])
                 g = groups[i]
                 if g is None:
                     continue
@@ -1745,7 +1880,11 @@ class BatchCoordinator:
         outbound: Dict[str, List] = {}
         for gid in aer_dirty:
             g = self.groups[gid]
-            if g is None or g.role != C.R_LEADER:
+            if g is None:
+                continue
+            ft = g.fresh_tail  # valid for THIS step only, whoever we are
+            g.fresh_tail = None
+            if g.role != C.R_LEADER:
                 continue
             li, _ = g.log.last_index_term()
             commit = g.last_applied  # host mirror of commit (applied == committed here)
@@ -1760,6 +1899,21 @@ class BatchCoordinator:
                 if nxt > li and commit <= g.commit_sent[s]:
                     continue  # nothing new to say
                 rpc = rpc_cache.get(nxt)
+                if rpc is None and ft is not None and nxt >= ft[0]:
+                    # steady state: the entries were appended by THIS
+                    # step's _handle_commands — ship them straight
+                    # through (no log re-read; all plain USR, one term)
+                    first_f, prev_f, term_f, ents_f = ft
+                    k = nxt - first_f
+                    if k < len(ents_f):
+                        rpc = AppendEntriesRpc(
+                            term=g.term, leader_id=sid, prev_log_index=nxt - 1,
+                            prev_log_term=prev_f if k == 0 else term_f,
+                            leader_commit=commit,
+                            entries=tuple(ents_f[k:k + self.aer_batch_size]),
+                            plain_usr=True,
+                        )
+                        rpc_cache[nxt] = rpc
                 if rpc is None:
                     entries: List[Entry] = []
                     if nxt <= li:
