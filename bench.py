@@ -255,7 +255,10 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
 
         # the cooperative spin loop shares ONE core with the WAL fsync
         # threads; the default 5 ms GIL switch interval would dominate
-        # every commit round trip (each fsync handoff pays it)
+        # every commit round trip (each fsync handoff pays it). Restored
+        # in the finally below — leaking 0.2 ms process-wide would tax
+        # every later caller in this interpreter
+        prev_switch_interval = sys.getswitchinterval()
         sys.setswitchinterval(0.0002)
 
         def latency_phase(n_waves: int) -> list:
@@ -373,6 +376,8 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             "loaded_p99_ms": round(float(np.percentile(loaded, 99) * 1000), 2),
         }
     finally:
+        if "prev_switch_interval" in locals():
+            sys.setswitchinterval(prev_switch_interval)
         for c in coords:
             c.stop()
         for tables, w, sw, d, _b in storage:

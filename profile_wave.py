@@ -8,7 +8,11 @@ import pstats
 import sys
 import time
 
-sys.argv = [sys.argv[0]]  # bench's argparse must not see ours
+# capture our CLI args BEFORE truncating (bench's argparse must not see
+# them) — truncating first silently dropped the documented [groups]
+# [cmds] arguments
+_ARGS = sys.argv[1:]
+sys.argv = [sys.argv[0]]
 
 
 def main(groups=2048, cmds=24):
@@ -31,6 +35,6 @@ def main(groups=2048, cmds=24):
 
 
 if __name__ == "__main__":
-    g = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-    c = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    g = int(_ARGS[0]) if len(_ARGS) > 0 else 2048
+    c = int(_ARGS[1]) if len(_ARGS) > 1 else 24
     main(g, c)

@@ -16,8 +16,9 @@ import threading
 import zlib
 from typing import Any, Dict, Optional
 
+from ra_tpu import faults
 from ra_tpu.log.meta import MetaApi
-from ra_tpu.utils.lib import atomic_write
+from ra_tpu.utils.lib import atomic_write, retry
 
 _FRAME = struct.Struct("<II")  # crc, len
 
@@ -28,6 +29,8 @@ class FileMeta(MetaApi):
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # failpoint scope label; the owning node sets it to its name
+        self.fault_scope = None
         self._lock = threading.Lock()
         self._tab: Dict[str, Dict[str, Any]] = {}
         self._dirty = False
@@ -68,8 +71,31 @@ class FileMeta(MetaApi):
         rec = _FRAME.pack(zlib.crc32(payload), len(payload)) + payload
         with self._lock:
             self._tab.setdefault(uid, {})[key] = value
-            self._f.write(rec)
+            start = self._f.tell()
+            attempt = [0]
+
+            def _write():
+                if attempt[0]:
+                    # a prior partial write may have left bytes: rewind
+                    # SIZE and POSITION to the pre-record offset (seek
+                    # matters after compaction reopens the journal in
+                    # "wb" mode — truncate alone would leave the write
+                    # position past the hole and recovery would stop at
+                    # the zero frame, losing the record). First attempts
+                    # pay nothing.
+                    self._f.truncate(start)
+                    self._f.seek(start)
+                attempt[0] += 1
+                faults.checked_write("meta.append", self._f, rec,
+                                     self.fault_scope)
+
+            retry(_write, attempts=3, delay_s=0.02)
             if sync:
+                # fdatasync is OUTSIDE the retry on purpose: a failed
+                # fsync is poison (the kernel may have dropped dirty
+                # pages covering EARLIER records, not just this one) —
+                # it must propagate to the caller, never be retried
+                # into a false "success" (same rule as Wal._sync)
                 self._f.flush()
                 os.fdatasync(self._f.fileno())
             else:

@@ -22,6 +22,8 @@ import struct
 import zlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
+from ra_tpu import faults
+
 MAGIC = b"RTS1"
 _HDR = struct.Struct("<4sI")
 _SLOT = struct.Struct("<QQQII")
@@ -77,7 +79,10 @@ class SegmentWriterHandle:
         crc = zlib.crc32(payload) if self.compute_checksums else 0
         off = self._data_end
         self._f.seek(off)
-        self._f.write(payload)
+        # a torn payload write leaves the index slot unwritten (idx 0),
+        # so recovery stops cleanly at the previous entry; a torn SLOT
+        # is caught by the per-entry CRC on read
+        faults.checked_write("segment.append", self._f, payload)
         self._f.seek(_HDR.size + self.count * _SLOT.size)
         self._f.write(_SLOT.pack(idx, term, off, len(payload), crc))
         self._data_end = off + len(payload)

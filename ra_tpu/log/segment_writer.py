@@ -23,9 +23,11 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ra_tpu import counters as ra_counters
+from ra_tpu import faults
 from ra_tpu.log.segment import SegmentWriterHandle
 from ra_tpu.protocol import encode_cmd
 from ra_tpu.log.tables import TableRegistry
+from ra_tpu.utils.lib import retry
 from ra_tpu.utils.seq import Seq
 
 NotifyFn = Callable[[str, object], None]
@@ -52,6 +54,8 @@ class SegmentWriter:
         self.counter = counter or ra_counters.Counters(
             "segment_writer", ra_counters.SEGMENT_WRITER_FIELDS
         )
+        # failpoint scope label; the owning node sets it to its name
+        self.fault_scope: Optional[str] = None
         self._open: Dict[str, SegmentWriterHandle] = {}
         self._cv = threading.Condition()
         self._queue: deque = deque()
@@ -130,10 +134,15 @@ class SegmentWriter:
 
     def _run(self) -> None:
         while True:
+            # injected thread death — supervision revives via
+            # revive_thread (in-flight job requeues at the front)
+            faults.fire("segment_writer.thread", self.fault_scope)
             with self._cv:
                 while not self._queue and not self._closed:
                     self._idle.set()
                     self._cv.wait(timeout=0.5)
+                    # idle loop checks the site too (see Wal._run)
+                    faults.fire("segment_writer.thread", self.fault_scope)
                 if self._closed and not self._queue:
                     self._idle.set()
                     return
@@ -191,6 +200,9 @@ class SegmentWriter:
         # the EXACT memtable table the WAL file referenced (successor
         # chains): a concurrent divergent overwrite must not change what
         # this flush persists.
+        # injected flush failure: lands in _drain's retry-with-backoff
+        # path (the WAL file is retained until the flush succeeds)
+        faults.fire("segment_writer.flush", self.fault_scope)
         snap_idx = self.tables.snapshot_index(uid)
         live = self.tables.live_indexes(uid)
         mt = self.tables.mem_table(uid)
@@ -232,8 +244,11 @@ class SegmentWriter:
         os.makedirs(d, exist_ok=True)
         existing = self.my_segments(uid)
         if existing:
-            h = SegmentWriterHandle(
-                os.path.join(d, existing[-1]), max_count=self.max_entries
+            h = retry(
+                lambda: SegmentWriterHandle(
+                    os.path.join(d, existing[-1]), max_count=self.max_entries
+                ),
+                attempts=3, delay_s=0.02,
             )
             if h.is_full():
                 h.close()
@@ -253,4 +268,7 @@ class SegmentWriter:
         n = int(prev_name.split(".")[0]) + 1 if prev_name else 1
         path = os.path.join(self._server_dir(uid), f"{n:08d}.segment")
         self.counter.incr("segments_created")
-        return SegmentWriterHandle(path, max_count=self.max_entries)
+        return retry(
+            lambda: SegmentWriterHandle(path, max_count=self.max_entries),
+            attempts=3, delay_s=0.02,
+        )

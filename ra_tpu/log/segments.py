@@ -27,10 +27,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ra_tpu import faults
 from ra_tpu.log.segment import SegmentReader, SegmentWriterHandle
 from ra_tpu.protocol import Entry
 from ra_tpu.utils.flru import FLRU
-from ra_tpu.utils.lib import sync_dir
+from ra_tpu.utils.lib import retry, sync_dir
 from ra_tpu.utils.seq import Seq
 
 # symlinks left by major compaction are kept briefly so in-flight
@@ -233,8 +234,18 @@ class SegmentSet:
                     # inline; fully-below-floor segments keep their dead
                     # entries until a major pass groups them (their
                     # sparseness is the grouping signal — reference
-                    # minor compaction likewise only deletes)
-                    self._minor_compact(f, keep)
+                    # minor compaction likewise only deletes). A failed
+                    # rewrite keeps the original (dead-entry GC is
+                    # best-effort; the next truncate retries it)
+                    try:
+                        self._minor_compact(f, keep)
+                    except OSError:
+                        tmp = os.path.join(self.dir, f + ".compacting")
+                        if os.path.exists(tmp):
+                            try:
+                                os.unlink(tmp)
+                            except OSError:
+                                pass
             self._rebuild_interval_index()
         return removed
 
@@ -248,6 +259,7 @@ class SegmentSet:
         w = SegmentWriterHandle(tmp_path, max_count=max(len(keep), 1))
         lo = hi = None
         for idx in keep:
+            faults.fire("segments.compact_copy")
             got = src.read(idx)
             if got is None:
                 continue
@@ -258,7 +270,12 @@ class SegmentSet:
         w.sync()
         w.close()
         self._cache.evict(fname)
-        os.replace(tmp_path, os.path.join(self.dir, fname))
+
+        def _swap():
+            faults.fire("segments.compact_rename")
+            os.replace(tmp_path, os.path.join(self.dir, fname))
+
+        retry(_swap, attempts=3, delay_s=0.02)
         if lo is not None:
             self.refs[fname] = (lo, hi)
 
@@ -390,6 +407,7 @@ class SegmentSet:
         w = SegmentWriterHandle(tmp, max_count=max(total, 1))
         try:
             for f, live_idx in grp:
+                faults.fire("segments.compact_copy")
                 r = SegmentReader(os.path.join(self.dir, f), mode=self.index_mode)
                 try:
                     for i in live_idx:
@@ -434,7 +452,17 @@ class SegmentSet:
         # reader following a symlink always sees merged data)
         for f in files:
             self._cache.evict(f)
-        os.replace(tmp, os.path.join(self.dir, first))
+
+        def _swap():
+            faults.fire("segments.compact_rename")
+            os.replace(tmp, os.path.join(self.dir, first))
+
+        try:
+            retry(_swap, attempts=3, delay_s=0.02)
+        except OSError:
+            # rename never landed: originals are intact — roll back
+            self._abort_merge(marker, tmp)
+            return
         sync_dir(self.dir)
 
         # 4. the rest become symlinks to the first

@@ -18,8 +18,9 @@ import struct
 import zlib
 from typing import Any, Iterator, List, Optional, Tuple
 
+from ra_tpu import faults
 from ra_tpu.protocol import SnapshotMeta
-from ra_tpu.utils.lib import sync_dir
+from ra_tpu.utils.lib import retry, sync_dir
 from ra_tpu.utils.seq import Seq
 
 SNAPSHOT = "snapshots"
@@ -74,8 +75,14 @@ class PickleCodec(SnapshotCodec):
     def _write_file(path: str, obj: Any, sync_pool=None) -> None:
         payload = pickle.dumps(obj)
         with open(path, "wb") as f:
-            f.write(payload)
-            f.write(_TRAILER.pack(zlib.crc32(payload)))
+            # a torn write here leaves a short body or a missing/torn
+            # CRC trailer, neither of which validates — recovery falls
+            # back to the previous capture generation. Two writes (not
+            # one concatenation): the body can be hundreds of MB and
+            # must not be copied just to append 4 trailer bytes
+            faults.checked_write("snapshot.write", f, payload)
+            faults.checked_write("snapshot.write", f,
+                                 _TRAILER.pack(zlib.crc32(payload)))
             f.flush()
             if sync_pool is None:
                 os.fsync(f.fileno())
@@ -139,7 +146,9 @@ class ChunkAccept:
         self.done = False
 
     def accept_chunk(self, data: bytes) -> None:
-        self._f.write(data)
+        # a torn/failed spool write leaves an .accepting dir that boot
+        # clears; the in-flight accept aborts (sender restarts transfer)
+        faults.checked_write("snapshot.chunk", self._f, data)
         self._crc = zlib.crc32(data, self._crc)
         self.chunks_accepted += 1
 
@@ -178,7 +187,16 @@ class ChunkAccept:
         final = os.path.join(d, store._dirname(self.meta))
         if os.path.exists(final):
             shutil.rmtree(final)
-        os.replace(self.tmp, final)
+
+        def _promote():
+            faults.fire("snapshot.promote")
+            os.replace(self.tmp, final)
+
+        try:
+            retry(_promote, attempts=3, delay_s=0.02)
+        except OSError:
+            self.abort()
+            raise
         sync_dir(d)
         store._prune_count(SNAPSHOT, 2)
         store._prune_older(CHECKPOINT, self.meta.index + 1)
@@ -244,7 +262,12 @@ class SnapshotStore:
             shutil.rmtree(tmp)
         os.makedirs(tmp)
         self.codec.write(tmp, meta, machine_state, sync_pool=self.sync_pool)
-        os.replace(tmp, final)
+
+        def _promote():
+            faults.fire("snapshot.promote")
+            os.replace(tmp, final)
+
+        retry(_promote, attempts=3, delay_s=0.02)
         sync_dir(d)
         if kind == SNAPSHOT:
             # keep the previous generation as a corruption safety net

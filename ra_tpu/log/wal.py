@@ -36,7 +36,9 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ra_tpu import counters as ra_counters
+from ra_tpu import faults
 from ra_tpu.log.tables import TableRegistry
+from ra_tpu.utils.lib import retry
 from ra_tpu.utils.seq import Seq
 
 MAGIC = b"RTW1"
@@ -96,6 +98,9 @@ class Wal:
         self.max_batch_size = max_batch_size
         self.sync_method = sync_method
         self.compute_checksums = compute_checksums
+        # failpoint scope label (multi-node tests target one node's
+        # storage); the owning node sets it to its name
+        self.fault_scope: Optional[str] = None
         # resolve (and if needed g++-build) the native framer NOW, off the
         # commit path — a lazy first-batch build would stall every queued
         # append behind a compiler run
@@ -164,6 +169,10 @@ class Wal:
         per-entry — bookkeeping, and framing expands the run natively).
         ``terms[k]``/``payloads[k]`` belong to index ``first + k``; all
         entries live in memtable table ``tid``."""
+        if not payloads:
+            # an empty run must not rewind _last_idx to first-1 in the
+            # writer loop or frame a zero-entry K_RUN record
+            return True
         with self._cv:
             if self._closed or self._failed:
                 return False
@@ -212,9 +221,17 @@ class Wal:
 
     def _run(self) -> None:
         while True:
+            # injected thread death (ThreadCrash is a BaseException: it
+            # falls through the except below and kills the thread; the
+            # node's infra supervisor detects and heals)
+            faults.fire("wal.thread", self.fault_scope)
             with self._cv:
                 while not self._queue and not self._closed:
                     self._cv.wait(timeout=0.5)
+                    # idle loop checks the site too: a crash_thread
+                    # nemesis must bite within one wait tick even with
+                    # no traffic (the cv lock releases on unwind)
+                    faults.fire("wal.thread", self.fault_scope)
                 if self._closed and not self._queue:
                     return
                 batch = self._take_batch_locked()
@@ -400,7 +417,8 @@ class Wal:
                 if self._failed:
                     return  # failed window: batch is unacked, drop it
                 try:
-                    self._file.write(buf)
+                    faults.checked_write("wal.write", self._file, buf,
+                                         self.fault_scope)
                     self._sync()
                 except (OSError, ValueError) as exc:
                     err = exc
@@ -433,6 +451,12 @@ class Wal:
             self._rollover()
 
     def _sync(self) -> None:
+        # fsync failure is POISON (fsyncgate): the page-cache state of
+        # the file is unknowable afterwards, so the raise below fails
+        # the whole writer (batch unacked, _failed set) and reopen()
+        # abandons the file — a later fsync on the same fd must never
+        # "succeed" and ack entries the kernel already dropped
+        faults.fire("wal.fsync", self.fault_scope)
         self._file.flush()
         if self.sync_method == "datasync":
             os.fdatasync(self._file.fileno())
@@ -499,7 +523,14 @@ class Wal:
     def _open_next(self) -> None:
         self._file_num += 1
         self._file_path = os.path.join(self.dir, f"{self._file_num:08d}.wal")
-        self._file = open(self._file_path, "ab")
+
+        def _open():
+            faults.fire("wal.open", self.fault_scope)
+            return open(self._file_path, "ab")
+
+        # transient open failures (EMFILE/EAGAIN bursts) retry with
+        # bounded backoff (reference: ra_file.erl retries every op)
+        self._file = retry(_open, attempts=3, delay_s=0.02)
         if self._file.tell() == 0:
             self._file.write(MAGIC)
             self._file.flush()
@@ -649,10 +680,17 @@ class Wal:
             pos = 0
             eof = False
 
+            def read_chunk() -> bytes:
+                faults.fire("wal.recover_read", self.fault_scope)
+                return f.read(self.RECOVER_CHUNK)
+
             def ensure(n: int) -> bool:
                 nonlocal buf, pos, eof
                 while len(buf) - pos < n and not eof:
-                    chunk = f.read(self.RECOVER_CHUNK)
+                    # transient read errors retry; a persistently bad
+                    # disk surfaces the OSError to boot (data may be
+                    # recoverable later — never silently unlink)
+                    chunk = retry(read_chunk, attempts=3, delay_s=0.02)
                     if not chunk:
                         eof = True
                         break

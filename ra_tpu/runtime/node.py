@@ -14,6 +14,7 @@ import logging
 import os
 import shutil
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -104,6 +105,7 @@ class RaNode:
 
         self.sync_pool = SyncPool()  # serialized snapshot fsyncs (ra_log_sync)
         self.meta = FileMeta(os.path.join(self.dir, "meta.dat"))
+        self.meta.fault_scope = name
         self.directory = Directory(self.meta)
         self._pre_init()
         self.sw = SegmentWriter(
@@ -113,6 +115,7 @@ class RaNode:
             max_entries=self.config.segment_max_entries,
             threaded=True,
         )
+        self.sw.fault_scope = name
         self.wal = Wal(
             os.path.join(self.dir, "wal"),
             self.tables,
@@ -124,7 +127,13 @@ class RaNode:
             compute_checksums=self.config.wal_compute_checksums,
             threaded=True,
         )
+        self.wal.fault_scope = name
         self.wal.on_failure = self._on_wal_failure
+        # supervision intensity accounting (see SystemConfig
+        # infra_restart_intensity): restart episodes stamped here; when
+        # the window overflows, infra_down latches and healing stops
+        self.infra_down = False
+        self._infra_restarts: deque = deque()
         from ra_tpu.detector import PhiAccrualDetector
 
         self.detector = PhiAccrualDetector()
@@ -368,6 +377,48 @@ class RaNode:
                     uid, meta.index, Seq.from_list(meta.live_indexes)
                 )
 
+    def _note_infra_restart(self) -> bool:
+        """Supervision intensity accounting (the OTP supervisor
+        intensity/period analog): stamp one restart episode; when more
+        than ``infra_restart_intensity`` land inside
+        ``infra_restart_window_s``, mark the node's storage infra DOWN
+        and tell the caller to throttle — a disk failing every few
+        seconds is not healing, and unthrottled restart churn would
+        just burn I/O while servers flap between wal_down/wal_up.
+        Healing is throttled to one attempt per window (never refused
+        outright: a disk that recovers minutes later must still heal
+        the node), and ``infra_down`` clears on the next success."""
+        import time as _t
+
+        now = _t.monotonic()
+        dq = self._infra_restarts
+        dq.append(now)
+        while dq and now - dq[0] > self.config.infra_restart_window_s:
+            dq.popleft()
+        if len(dq) > self.config.infra_restart_intensity:
+            dq.pop()  # a throttled attempt does not count as an episode
+            if not self.infra_down:
+                self.infra_down = True
+                logger.error(
+                    "supervision: >%d log-infra restarts within %.1fs on %s "
+                    "— marking storage infra DOWN (healing throttled to one "
+                    "attempt per window; recover_infra() forces one now)",
+                    self.config.infra_restart_intensity,
+                    self.config.infra_restart_window_s, self.name,
+                )
+            return False
+        return True
+
+    def recover_infra(self) -> None:
+        """Operator hook: clear the intensity window and run one healing
+        cycle immediately (fresh WAL file, wal_up resend) — the 'disk
+        replaced, bring the node back now' path."""
+        self._infra_restarts.clear()
+        self.infra_down = False
+        if not self.sw.thread_alive():
+            self.sw.revive_thread()
+        self._on_wal_failure(RuntimeError("operator recover_infra"))
+
     def _on_wal_failure(self, exc: BaseException) -> None:
         """The shared WAL failed (I/O error or dead writer thread): put
         every server into await_condition, then restart the WAL on a
@@ -380,13 +431,20 @@ class RaNode:
         # DROPPED episode would wedge the node forever.
         for proc in list(self.procs.values()):
             proc.enqueue(LogEvent(("wal_down",)))
+        throttled = not self._note_infra_restart()
 
         def restart():
             import time as _t
 
+            if throttled:
+                # intensity exceeded: cool down for one window before
+                # the next attempt (the wal stays failed meanwhile, so
+                # no further episodes stack behind this one)
+                _t.sleep(self.config.infra_restart_window_s)
             delay = 0.05
             while self.running:
                 if self.wal.reopen():
+                    self.infra_down = False
                     for proc in list(self.procs.values()):
                         proc.enqueue(LogEvent(("wal_up",)))
                     return
@@ -560,8 +618,17 @@ class RaNode:
         wal_up healing cycle as an I/O failure, with no operator
         action."""
         if not self.sw.thread_alive():
-            logger.error("supervision: segment-writer thread died; reviving")
-            self.sw.revive_thread()
+            # throttled (intensity exceeded): retry on a later poll,
+            # once the oldest episode decays out of the window
+            if self._note_infra_restart():
+                logger.error(
+                    "supervision: segment-writer thread died; reviving")
+                self.sw.revive_thread()
+                if not self.wal.failed and self.wal.thread_alive():
+                    # the revive succeeded and the WAL is healthy: the
+                    # sw-only throttle episode is over (the WAL restart
+                    # path clears the flag on its own success)
+                    self.infra_down = False
         if not self.wal.thread_alive() and not self.wal.failed:
             logger.error("supervision: wal thread died; restarting log infra")
             self._on_wal_failure(RuntimeError("wal writer thread died"))
@@ -660,6 +727,8 @@ class RaNode:
                 for uid, (n, r, l) in self.ra_state.items()
             },
             "wal": self.wal.overview(),
+            "infra_down": self.infra_down,
+            "infra_restarts_in_window": len(self._infra_restarts),
         }
 
     def stop(self) -> None:

@@ -44,6 +44,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
+from ra_tpu import faults
 from ra_tpu.protocol import ServerId
 
 logger = logging.getLogger("ra_tpu")
@@ -145,6 +146,13 @@ class TcpTransport:
         if self.drop_fn is not None and self.drop_fn(to, msg):
             self.dropped += 1
             return False
+        try:
+            # injected send fault: raise -> reported undeliverable (the
+            # caller's resend machinery covers it); latency just delays
+            faults.fire("tcp.send", self.node_name)
+        except OSError:
+            self.dropped += 1
+            return False
         peer = self._peer(node_name)
         if peer is None:
             self.dropped += 1
@@ -221,7 +229,10 @@ class TcpTransport:
 
     def _seal(self, payload: bytes) -> bytes:
         mac = hmac.new(self._cookie, payload, hashlib.sha256).digest()[:_MAC_LEN]
-        return mac + payload
+        # injected frame corruption (torn -> truncated, raise -> bit
+        # flip): the receiver's MAC check kills the connection, the
+        # sender reconnects lazily — the wire-corruption drill
+        return faults.mangle("tcp.frame", mac + payload, self.node_name)
 
     def _open(self, frame: bytes) -> Optional[bytes]:
         if len(frame) < _MAC_LEN:

@@ -90,6 +90,14 @@ class SystemConfig:
     snapshot_install_timeout_s: int = SNAPSHOT_INSTALL_TIMEOUT_S
     # registered: restart every registered server on system start.
     server_recovery_strategy: str = "none"  # none | registered
+    # log-infra supervision intensity (the OTP supervisor analog): more
+    # than ``infra_restart_intensity`` WAL/segment-writer restart
+    # episodes inside ``infra_restart_window_s`` seconds marks the
+    # node's storage infra DOWN — servers stay in await_condition and
+    # the operator must intervene (a disk that fails every few seconds
+    # is not healing; endless restarts would just churn)
+    infra_restart_intensity: int = 5
+    infra_restart_window_s: float = 10.0
     # all: bump machine version when leader supports it; quorum: when a
     # quorum of members support it (reference: src/ra_server.erl:223-233).
     machine_upgrade_strategy: str = "all"

@@ -4,7 +4,10 @@ Capability parity with the reference's ``test/nemesis.erl`` scenario
 runner (``{part, Nodes, Ms} | {wait, Ms} | {app_restart, Servers} |
 heal`` — test/nemesis.erl:29-33, over inet_tcp_proxy): here the faults
 drive the in-proc transport's partition hooks, so the same scripts work
-against actor nodes and batch coordinators.
+against actor nodes and batch coordinators. Beyond network faults, the
+vocabulary covers DISK faults and infra-thread crashes through the
+failpoint registry (``ra_tpu.faults``) — the storage half of the fault
+model the BlackWater-style robustness work calls for.
 """
 
 from __future__ import annotations
@@ -12,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Any, List, Sequence, Tuple
 
+from ra_tpu import faults
 from ra_tpu.runtime.transport import registry as node_registry
 
 
@@ -36,6 +40,21 @@ def partition(minority: Sequence[str], rest: Sequence[str]) -> None:
             _block_pair(a, b)
 
 
+def crash_thread(node: str, which: str) -> None:
+    """Arm a one-shot thread-crash failpoint against ``node``'s WAL or
+    segment-writer loop (``which`` in {"wal", "segment_writer"}). The
+    loop hits its site within one wait tick (≤0.5s) even when idle; the
+    node's infra supervisor then detects and heals."""
+    if which not in ("wal", "segment_writer"):
+        raise ValueError(f"unknown infra thread {which!r}")
+    faults.arm(f"{which}.thread", ("crash",), ("one_shot",), scope=node)
+
+
+def heal_disk() -> None:
+    """Disarm every failpoint (the disk-fault analog of heal_all)."""
+    faults.disarm_all()
+
+
 def run_scenario(script: List[Tuple], api_mod=None) -> None:
     """Execute a nemesis script. Steps:
 
@@ -44,6 +63,12 @@ def run_scenario(script: List[Tuple], api_mod=None) -> None:
     ("wait", seconds)
     ("restart", [server_ids...])                    — restart server procs
     ("heal",)
+    ("disk_fault", site, action, trigger[, node])   — arm a failpoint
+        (grammar in ra_tpu.faults; node scopes it to one node's storage)
+    ("crash_thread", node, which)                   — kill an infra
+        thread ("wal" | "segment_writer") on node via a one-shot
+        crash failpoint
+    ("heal_disk",)                                  — disarm everything
     """
     for step in script:
         op = step[0]
@@ -64,5 +89,14 @@ def run_scenario(script: List[Tuple], api_mod=None) -> None:
                 (api_mod or _api).restart_server(sid)
         elif op == "heal":
             heal_all()
+        elif op == "disk_fault":
+            _, site, action, trigger = step[:4]
+            faults.arm(site, tuple(action), tuple(trigger),
+                       scope=step[4] if len(step) > 4 else None)
+        elif op == "crash_thread":
+            _, node, which = step
+            crash_thread(node, which)
+        elif op == "heal_disk":
+            heal_disk()
         else:
             raise ValueError(f"unknown nemesis step {step!r}")

@@ -73,15 +73,28 @@ def sync_dir(path: str) -> None:
         os.close(fd)
 
 
-def retry(fn: Callable[[], T], attempts: int = 3, delay_s: float = 0.05) -> T:
+def retry(
+    fn: Callable[[], T],
+    attempts: int = 3,
+    delay_s: float = 0.05,
+    max_delay_s: float = 1.0,
+    backoff: float = 2.0,
+) -> T:
+    """Bounded-exponential-backoff retry for transient file ops — the
+    uniform wrapper the storage stack puts around opens/renames/copies
+    (reference: ``src/ra_file.erl:1-37`` retries every op). Worst-case
+    total sleep with the defaults is 0.05 + 0.1 = 0.15s; callers on a
+    commit path keep attempts small."""
     last: Exception | None = None
+    d = delay_s
     for i in range(attempts):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 - retry any failure
             last = e
             if i + 1 < attempts:
-                time.sleep(delay_s)
+                time.sleep(d)
+                d = min(d * backoff, max_delay_s)
     assert last is not None
     raise last
 
