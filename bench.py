@@ -213,6 +213,9 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         sample = np.arange(0, groups, max(1, groups // 256), dtype=np.int64)
 
         def run_wave(n_waves: int, loaded_lats: list = None) -> None:
+            """Pre-queue ``n_waves`` full-fleet waves (the UNBOUNDED
+            deep-pipelined shape — delivery->apply latency is dominated
+            by queueing, recorded as unbounded_loaded_*)."""
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             wave_t: list = []
             base0 = base[sample].copy()
@@ -237,6 +240,66 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 if all((c._applied_np[:groups] >= base).all() for c in coords):
                     return
             raise TimeoutError("wave did not complete")
+
+        def run_wave_admitted(n_waves: int, window: int, lats: list) -> None:
+            """Admission-paced load: the fleet's n_waves x groups
+            commands are delivered as group SLICES (groups/16 lanes at a
+            time), with at most ``window`` slices in flight past the
+            LEADER apply floor — a client fleet respecting a bounded
+            fleet-wide in-flight budget instead of pre-queueing
+            everything (the r5 shape whose loaded p99 measured its own
+            24.5 s queue). The slice width keeps the in-flight set
+            inside the coordinator's active-set threshold (capacity/4),
+            so the step cost scales with the admitted load — which is
+            the whole point of admission. Latency = slice delivery ->
+            leader apply. The floor reads leaders only: follower floors
+            lag by a commit-sync round and would stall the window on
+            the probe cadence whenever traffic pauses."""
+            cmd = Command(kind=USR, data=1, reply_mode="noreply")
+            start = base.copy()
+            slice_w = max(1, groups // 16)
+            slices = [
+                np.arange(lo, min(lo + slice_w, groups))
+                for lo in range(0, groups, slice_w)
+            ]
+            slice_names = [[names[g] for g in sl] for sl in slices]
+            in_sample = set(int(g) for g in sample)
+            queue = [(k, si) for k in range(n_waves)
+                     for si in range(len(slices))]
+            qi = 0
+            from collections import deque as _deque
+            pending = _deque()  # (slice_idx, t_delivered, target_waves)
+            deliv = np.zeros(groups, np.int64)
+            while time.time() < deadline:
+                while qi < len(queue) and len(pending) < window:
+                    _k, si = queue[qi]
+                    qi += 1
+                    deliv[slices[si]] += 1
+                    pending.append(
+                        (si, time.perf_counter(), int(deliv[slices[si][0]]))
+                    )
+                    coords[0].deliver_commands(slice_names[si], cmd)
+                step_all()
+                while pending:
+                    si, t0w, tgt = pending[0]
+                    sl = slices[si]
+                    if not (
+                        coords[0]._applied_np[sl] - start[sl] >= tgt
+                    ).all():
+                        break
+                    now = time.perf_counter()
+                    lats.extend(
+                        now - t0w for g in sl if int(g) in in_sample
+                    )
+                    pending.popleft()
+                if qi >= len(queue) and not pending:
+                    if all(
+                        (c._applied_np[:groups] - start >= n_waves).all()
+                        for c in coords
+                    ):
+                        base[:] = start + n_waves
+                        return
+            raise TimeoutError("admitted wave did not complete")
 
         def drain_storage(timeout_s: float = 120.0) -> None:
             """Wait for the WALs/segment writers to digest any backlog so
@@ -317,10 +380,25 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # best-of-3 measured passes: the rate measures framework
         # capability, and a single pass on a shared 1-core host is at
         # the mercy of transient load spikes (every pass still verifies
-        # every group's full end-to-end state)
+        # every group's full end-to-end state). The throughput passes
+        # stay deep-pipelined (the reference's own methodology:
+        # PIPE_SIZE=500 in-flight per client, src/ra_bench.erl:18-19;
+        # per-group depth stays inside the server admission window) —
+        # their delivery->apply latency is queueing-dominated by
+        # construction and recorded as unbounded_loaded_*. The LOADED
+        # LATENCY number comes from a separate admission-paced pass
+        # below (at most ADMIT_WINDOW waves in flight past the slowest
+        # apply floor): the former pre-queued loaded p99 (24.5 s at r5)
+        # measured the queue, not the system.
+        # window depth trades latency for nothing in steady state (the
+        # drip rate is window-independent; depth only sets how long a
+        # slice queues behind its predecessors), so keep it at 1:
+        # strictly sequential slices — still groups/16 concurrent
+        # commands in flight across as many raft lanes
+        ADMIT_WINDOW = 1
         total = groups * cmds
         best = 0.0
-        loaded: list = []
+        unbounded: list = []
         for _pass in range(3):
             # per-group baselines: the latency warmup advances only the
             # sampled groups, so states are not uniform across groups
@@ -329,7 +407,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             ]
             t0 = time.perf_counter()
             try:
-                run_wave(cmds, loaded_lats=loaded)
+                run_wave(cmds, loaded_lats=unbounded)
             except TimeoutError:
                 if best > 0:
                     # a fully verified earlier pass already produced a
@@ -358,22 +436,61 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 _retry_on_cpu_or_fail()
             best = max(best, total / dt)
 
+        # the admission-paced loaded pass: the client keeps at most
+        # ADMIT_WINDOW waves in flight past the slowest group's apply
+        # floor, so delivery->apply measures commit latency UNDER load
+        # instead of time-in-queue. Its rate is reported too — the
+        # throughput cost of bounding latency is part of the story.
+        loaded: list = []
+        admitted_rate = None
+        deadline = time.time() + 600  # fresh budget for this phase
+        # steady-state latency needs rounds, not the full 96-wave
+        # throughput workload: a quarter of the waves keeps the pass
+        # inside its budget at 10k groups
+        adm_waves = max(1, min(cmds, 24))
+        t0 = time.perf_counter()
+        try:
+            run_wave_admitted(adm_waves, ADMIT_WINDOW, loaded)
+            admitted_rate = round(
+                groups * adm_waves / (time.perf_counter() - t0), 1)
+        except TimeoutError:
+            print("bench: admission-paced pass timed out; loaded_* "
+                  "reported from partial data", file=sys.stderr)
+
         return {
             "metric": (
                 f"durable replicated commands/sec ({groups} groups x 3 "
                 f"replicas, {'shared-WAL fsync-gated logs' if wal else 'in-memory logs (routing ceiling)'}, "
                 f"tpu_batch coordinators, device {jax.devices()[0].platform}, "
                 f"best of 3 passes; p50/p99 = unloaded commit latency, "
-                f"loaded_p50/p99 = delivery->apply under the pipelined "
-                f"saturation load, both over {len(sample)} sampled groups)"
+                f"loaded_p50/p99 = delivery->apply with client admission "
+                f"({ADMIT_WINDOW} slice of groups/16 lanes in flight), "
+                f"unbounded_loaded_* = the pre-queued comparison shape, "
+                f"all over {len(sample)} sampled groups)"
             ),
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
             "p50_ms": round(p50, 2),
             "p99_ms": round(p99, 2),
-            "loaded_p50_ms": round(float(np.percentile(loaded, 50) * 1000), 2),
-            "loaded_p99_ms": round(float(np.percentile(loaded, 99) * 1000), 2),
+            "admission_inflight_slices": ADMIT_WINDOW,
+            "admitted_cmds_per_sec": admitted_rate,
+            "loaded_p50_ms": (
+                round(float(np.percentile(loaded, 50) * 1000), 2)
+                if loaded else None
+            ),
+            "loaded_p99_ms": (
+                round(float(np.percentile(loaded, 99) * 1000), 2)
+                if loaded else None
+            ),
+            "unbounded_loaded_p50_ms": (
+                round(float(np.percentile(unbounded, 50) * 1000), 2)
+                if unbounded else None
+            ),
+            "unbounded_loaded_p99_ms": (
+                round(float(np.percentile(unbounded, 99) * 1000), 2)
+                if unbounded else None
+            ),
         }
     finally:
         if "prev_switch_interval" in locals():

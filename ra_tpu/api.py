@@ -220,10 +220,23 @@ def process_command(
     surfaced as RaError by default — the command MAY still commit later.
     ``retry_on_timeout=True`` rotates to other members instead, giving
     at-least-once semantics (duplicates possible; dedup via machine-level
-    correlations, as in the reference)."""
+    correlations, as in the reference).
+
+    A deposed leader answers its pending commands immediately instead of
+    leaving clients to hang out their timeout: ``("maybe", hint)`` when
+    the entry survives in its log (it MAY still commit — surfaced as
+    RaError unless ``retry_on_timeout``, exactly like the timeout case,
+    but bounded and instant), or ``("redirect", hint)`` when the entry
+    was truncated away (provably dead, retried here exactly-once
+    safely).
+
+    An overloaded leader replies ``("reject", "overloaded")`` (admission
+    window full — see docs/INTERNALS.md §12): the command was NOT
+    appended, so the bounded-backoff retry below is exactly-once safe."""
     deadline = time.monotonic() + timeout
     target = server_id
     tried: set = set()
+    backoff = 0.01
     while time.monotonic() < deadline:
         fut = Future()
         cmd = Command(kind=USR, data=data, reply_mode="await_consensus", from_ref=fut)
@@ -247,12 +260,31 @@ def process_command(
             continue
         if reply[0] == "ok":
             return reply[1], reply[2]
-        if reply[0] == "redirect":
+        if reply[0] in ("redirect", "maybe"):
+            # "maybe": leader deposed with the entry still in its log —
+            # the command may yet commit. Same contract as a timeout
+            # (error out unless the caller accepted at-least-once), but
+            # detected and surfaced in milliseconds, not after the full
+            # client timeout (the round-5 wedge shape). "redirect" is a
+            # clean never-appended verdict: always safe to re-send.
+            if reply[0] == "maybe" and not retry_on_timeout:
+                raise RaError(
+                    f"command outcome unknown against {target} (leader "
+                    f"deposed; it may still commit)"
+                )
             leader = reply[1]
             tried.add(target)
             target = leader if leader is not None and leader != target else _next_target(
                 server_id, target, tried
             )
+            continue
+        if reply[0] == "reject":
+            # reject-with-backoff: the leader's admission window is
+            # full. Hold off (bounded exponential), then retry the SAME
+            # leader — the command was never appended, so no duplicate
+            # risk. tried is not updated: this member is healthy.
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2, 0.25)
             continue
         raise RaError(f"command failed: {reply!r}")
     raise RaError("command timed out")
@@ -288,6 +320,58 @@ def _cluster_of(sid: ServerId) -> Optional[str]:
     return d.cluster_of(uid) if uid else None
 
 
+class AdmissionWindow:
+    """Client-side in-flight command window: bounds how many commands a
+    client keeps outstanding against apply progress instead of queueing
+    unbounded work into the cluster (the client half of the flow-control
+    design in docs/INTERNALS.md §12; servers enforce their own
+    ``max_command_backlog`` and reject past it).
+
+    Usage::
+
+        win = AdmissionWindow(64)
+        if win.acquire(timeout=1.0):      # blocks while the window is full
+            try:  ... issue the command ...
+            finally: win.release()        # on ack/timeout/reject
+
+    Counters (``("admission", name)`` in ra_tpu.counters): ``admitted``,
+    ``throttled`` (acquire had to wait), ``in_flight`` gauge."""
+
+    FIELDS = [
+        ("admitted", "counter", "commands admitted through the window"),
+        ("throttled", "counter", "acquisitions that had to wait"),
+        ("in_flight", "gauge", "commands currently outstanding"),
+    ]
+
+    def __init__(self, limit: int, name: str = "client"):
+        from ra_tpu import counters as _counters
+
+        if limit <= 0:
+            raise ValueError("admission window limit must be positive")
+        self.limit = limit
+        self._sem = threading.BoundedSemaphore(limit)
+        self._n = 0
+        self._n_lock = threading.Lock()
+        self.counters = _counters.new(("admission", name), self.FIELDS)
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        if not self._sem.acquire(blocking=False):
+            self.counters.incr("throttled")
+            if not self._sem.acquire(timeout=timeout):
+                return False
+        with self._n_lock:
+            self._n += 1
+            self.counters.put("in_flight", self._n)
+        self.counters.incr("admitted")
+        return True
+
+    def release(self) -> None:
+        with self._n_lock:
+            self._n -= 1
+            self.counters.put("in_flight", self._n)
+        self._sem.release()
+
+
 def pipeline_command(
     server_id: ServerId, data: Any, correlation: Any, who: Any,
     priority: str = "normal",
@@ -295,7 +379,13 @@ def pipeline_command(
     """Async command: the applied notification arrives on the client sink
     registered as ``who`` (reference: ra:pipeline_command + {applied,
     Corrs} ra_events). ``priority="low"`` buffers the command behind
-    normal traffic, drained in bounded slices."""
+    normal traffic, drained in bounded slices.
+
+    At-most-once: an overloaded leader may shed the command past its
+    admission window (counted in ``commands_dropped_overload``) — the
+    applied notification then never arrives, and the caller must
+    resend by correlation, exactly as with a lost message (the
+    reference gives pipeline_command the same non-guarantee)."""
     cmd = Command(kind=USR, data=data, reply_mode=("notify", correlation, who),
                   priority=priority)
     return _try_send(server_id, cmd)
@@ -413,10 +503,16 @@ def _leader_control(server_id: ServerId, msg_builder, timeout: float = 5.0):
             out = fut.result(max(0.05, deadline - time.monotonic()))
         except TimeoutError:
             break
-        if isinstance(out, tuple) and out and out[0] == "redirect":
+        if isinstance(out, tuple) and out and out[0] in ("redirect", "maybe"):
+            # membership commands are self-deduplicating (a re-sent
+            # join/leave resolves to already_member/not_member), so a
+            # "maybe" deposition verdict is safe to retry here
             tried.add(target)
             target = out[1] or _next_target(server_id, target, tried)
             continue
+        if isinstance(out, tuple) and out and out[0] == "reject":
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+            continue  # admission window full: back off, same leader
         return out
     raise RaError("leader control call timed out")
 

@@ -26,6 +26,12 @@ FieldSpec = Tuple[str, str, str]
 # ra_server counter index definitions (src/ra.hrl:266-438).
 RA_SERVER_FIELDS: List[FieldSpec] = [
     ("commands", "counter", "commands received by the leader"),
+    ("commands_rejected", "counter",
+     "client commands rejected with overloaded (admission window)"),
+    ("commands_dropped_overload", "counter",
+     "ack-free commands dropped past the admission window"),
+    ("stale_peer_resends", "counter",
+     "pipeline-window stalls resolved by rewinding to the peer match"),
     ("msgs_sent", "counter", "protocol messages sent"),
     ("dropped_sends", "counter", "sends dropped due to backpressure"),
     ("send_msg_effects_sent", "counter", "send_msg effects executed"),
@@ -69,7 +75,8 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
 WAL_FIELDS: List[FieldSpec] = [
     ("wal_files", "counter", "WAL files opened"),
     ("batches", "counter", "write batches flushed"),
-    ("writes", "counter", "entries written"),
+    ("writes", "counter", "write requests (queue items) flushed"),
+    ("entries", "counter", "log entries written (runs expanded)"),
     ("bytes_written", "counter", "bytes written"),
     ("fsyncs", "counter", "fsync calls"),
     ("fsync_time_us", "counter", "cumulative fsync time (us)"),
@@ -77,6 +84,33 @@ WAL_FIELDS: List[FieldSpec] = [
     ("out_of_seq", "counter", "out-of-sequence writes detected"),
     ("rollovers", "counter", "WAL file rollovers"),
     ("failures", "counter", "I/O failures (WAL entered failed state)"),
+]
+
+# Flow-control / liveness counters for a batch coordinator's command
+# lane (one vector per coordinator, name ("coordinator", node_name)).
+# These are the gauges an operator watches for overload: rejects and
+# drops mean clients are past the admission window; lane_wedges firing
+# means accepted commands stopped committing (the watchdog recovers or
+# bounds them instead of hanging clients).
+COORDINATOR_FIELDS: List[FieldSpec] = [
+    ("commands_rejected", "counter",
+     "client commands rejected with overloaded (reject-with-backoff)"),
+    ("commands_dropped_overload", "counter",
+     "ack-free (noreply) commands dropped past the admission window"),
+    ("pending_redirected", "counter",
+     "pending client futures answered with a redirect on deposition/"
+     "truncation instead of being silently dropped"),
+    ("lane_wedges", "counter",
+     "watchdog detections of a wedged command lane (accepted command, "
+     "no commit progress within the deadline)"),
+    ("lane_recoveries", "counter",
+     "watchdog recovery attempts (re-step + peer resync probe)"),
+    ("lane_redirects", "counter",
+     "watchdog second-strike bounded failures (pending futures "
+     "redirected so clients retry elsewhere)"),
+    ("stale_peer_resends", "counter",
+     "pipeline-window stalls against a silent peer resolved by an "
+     "empty probe AER (its ack/reject hint resynchronizes match/next)"),
 ]
 
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [

@@ -43,7 +43,11 @@ from ra_tpu.system import SystemConfig
 
 
 class DictKv(Machine):
-    """Plain replicated map: ("put", k, v) | ("delete", k)."""
+    """Plain replicated map: ("put", k, v) | ("delete", k) |
+    ("incr", k, n). The incr op makes duplicate application VISIBLE
+    (a re-applied put is indistinguishable from one apply; a re-applied
+    incr inflates the total) — the overload dimension leans on it to
+    assert zero lost/duplicated acked commands."""
 
     def init(self, config):
         return {}
@@ -59,6 +63,10 @@ class DictKv(Machine):
                 state = dict(state)
                 state.pop(cmd[1], None)
                 return state, ("ok", None), []
+            if op == "incr":
+                state = dict(state)
+                state[cmd[1]] = state.get(cmd[1], 0) + cmd[2]
+                return state, ("ok", state[cmd[1]]), []
         return state, None, []
 
     def apply_many(self, meta, cmds, state):
@@ -69,6 +77,8 @@ class DictKv(Machine):
                     state[cmd[1]] = cmd[2]
                 elif cmd[0] == "delete":
                     state.pop(cmd[1], None)
+                elif cmd[0] == "incr":
+                    state[cmd[1]] = state.get(cmd[1], 0) + cmd[2]
         return state
 
 
@@ -110,6 +120,7 @@ def run(
     op_timeout: float = 10.0,
     rescue: bool = False,
     disk_faults: bool = False,
+    overload: bool = False,
 ) -> HarnessResult:
     """``rescue=True`` lets the harness fire operator election kicks on
     a stuck deployment (useful when hunting consistency bugs past a
@@ -131,11 +142,13 @@ def run(
         restarts = backend == "per_group_actor"
     if backend == "per_group_actor":
         return _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
-                          membership, op_timeout, rescue, disk_faults)
+                          membership, op_timeout, rescue, disk_faults,
+                          overload=overload)
     if backend == "tpu_batch":
         return _run_batch(seed, n_ops, nodes, partitions, membership,
                           op_timeout, rescue, restarts=restarts,
-                          disk_faults=disk_faults, data_dir=data_dir)
+                          disk_faults=disk_faults, data_dir=data_dir,
+                          overload=overload)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -182,9 +195,142 @@ class _Model:
             self.check_read(k, state.get(k), where)
 
 
+# overload phase sizing: the backends under overload=True are built
+# with max_command_backlog=_OVERLOAD_BACKLOG, and the flood below is
+# sized to blow well past it
+_OVERLOAD_BACKLOG = 64
+_OVERLOAD_CLIENTS = 4
+_OVERLOAD_OPS = 30
+_OVERLOAD_FLOOD = 600
+
+
+def _overload_phase(model, cluster, op_timeout, counts, seed) -> None:
+    """Drive the cluster PAST the admission window and assert the
+    flow-control contract (ISSUE 5 tentpole item 5):
+
+    - bounded latency: every acked incr completed inside op_timeout and
+      the whole phase inside a fixed deadline (no silent 10 s hangs);
+    - zero lost acked commands and zero duplicated commands: the final
+      consistent total of the incr key must land in
+      [n_acked, n_acked + n_uncertain] — a lost ack undershoots, ANY
+      duplicate application overshoots;
+    - the window really was exceeded: the admission counters
+      (rejected/dropped/throttled) must have fired somewhere.
+
+    Runs on a healed cluster after the nemesis loop; talks only to the
+    public api surface, so it is backend-agnostic."""
+    import threading
+
+    from ra_tpu import counters as ra_counters
+
+    def _admission_totals() -> int:
+        total = 0
+        for vals in ra_counters.overview().values():
+            for f in ("commands_rejected", "commands_dropped_overload",
+                      "throttled"):
+                total += vals.get(f, 0)
+        return total
+
+    before = _admission_totals()
+    win = api.AdmissionWindow(16, name=f"kvh_overload_{seed}")
+    lock = threading.Lock()
+    acked = [0]
+    uncertain = [0]
+    lats: List[float] = []
+    t_phase = time.monotonic()
+
+    def client(ci: int) -> None:
+        for _ in range(_OVERLOAD_OPS):
+            if not win.acquire(timeout=op_timeout):
+                continue  # never admitted: provably no effect
+            t0 = time.monotonic()
+            try:
+                api.process_command(
+                    cluster[ci % len(cluster)], ("incr", "ov_total", 1),
+                    timeout=op_timeout,
+                )
+                with lock:
+                    acked[0] += 1
+                    lats.append(time.monotonic() - t0)
+            except Exception:  # noqa: BLE001 — may or may not commit
+                with lock:
+                    uncertain[0] += 1
+            finally:
+                win.release()
+
+    threads = [
+        threading.Thread(target=client, args=(ci,), daemon=True)
+        for ci in range(_OVERLOAD_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    # ack-free flood straight past the server admission window: these
+    # may be DROPPED (counted) but must never duplicate — the final
+    # ov_flood total is bounded by the flood size
+    flood_cmd_total = 0
+    for _ in range(_OVERLOAD_FLOOD):
+        for sid in cluster:
+            if api._try_send(
+                sid, Command(kind=USR, data=("incr", "ov_flood", 1),
+                             reply_mode="noreply")
+            ):
+                flood_cmd_total += 1
+    for t in threads:
+        t.join(timeout=op_timeout * _OVERLOAD_OPS)
+    phase_s = time.monotonic() - t_phase
+    counts["overload_acked"] = acked[0]
+    counts["overload_uncertain"] = uncertain[0]
+    # settle: the admitted backlog must drain
+    final = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            out = api.consistent_query(cluster[0], lambda s: dict(s),
+                                       timeout=op_timeout)
+            total = out[1].get("ov_total", 0)
+            if total >= acked[0]:
+                final = out[1]
+                break
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.2)
+    if final is None:
+        model.failures.append("overload: cluster never drained the backlog")
+        return
+    total = final.get("ov_total", 0)
+    if not (acked[0] <= total <= acked[0] + uncertain[0]):
+        model.failures.append(
+            f"overload: acked={acked[0]} uncertain={uncertain[0]} but "
+            f"ov_total={total} — lost or duplicated acked commands"
+        )
+    flood_total = final.get("ov_flood", 0)
+    if flood_total > flood_cmd_total:
+        model.failures.append(
+            f"overload: ov_flood={flood_total} > {flood_cmd_total} "
+            f"delivered — duplicated ack-free commands"
+        )
+    # +0.5s slack: process_command's last attempt may legitimately
+    # return "ok" ~50ms past the nominal deadline (its per-attempt wait
+    # floors at 0.05s), plus scheduling jitter on a loaded box
+    if lats and max(lats) > op_timeout + 0.5:
+        model.failures.append(
+            f"overload: acked latency {max(lats):.1f}s exceeded "
+            f"op_timeout {op_timeout}s"
+        )
+    if phase_s > 120:
+        model.failures.append(
+            f"overload: phase took {phase_s:.0f}s — unbounded queueing"
+        )
+    if _admission_totals() <= before:
+        model.failures.append(
+            "overload: admission counters never fired — the phase did "
+            "not exceed the window (cap too high or flood too small)"
+        )
+
+
 def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                membership, op_timeout, rescue=False,
-               disk_faults=False) -> HarnessResult:
+               disk_faults=False, overload=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.machine import register_machine_factory
@@ -195,7 +341,12 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
     names = [f"kvh{seed}_{i}" for i in range(nodes + 1)]  # +1 spare for joins
     for n in names:
         api.start_node(
-            n, SystemConfig(name=f"kvh{seed}", data_dir=f"{base}/{n}"),
+            n, SystemConfig(
+                name=f"kvh{seed}", data_dir=f"{base}/{n}",
+                default_max_command_backlog=(
+                    _OVERLOAD_BACKLOG if overload else 4096
+                ),
+            ),
             election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
         )
     ids = [(f"kv{i}", names[i]) for i in range(nodes)]
@@ -357,6 +508,8 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                     time.sleep(0.2)
             for sid in laggards:
                 model.failures.append(f"replica {sid} never converged")
+        if overload and not model.failures:
+            _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         if disk_faults:
             faults.disarm_all()
@@ -374,7 +527,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
-               data_dir=None) -> HarnessResult:
+               data_dir=None, overload=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.log.log import Log
@@ -423,6 +576,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         c = BatchCoordinator(
             n, capacity=8, num_peers=nodes + 1, tick_interval_s=0.3,
             meta=storage[n]["meta"] if use_disk else None,
+            max_command_backlog=_OVERLOAD_BACKLOG if overload else 4096,
         )
         if use_disk:
             storage[n]["ref"]["c"] = c
@@ -638,6 +792,8 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                     f"{sorted(g.machine_state)[:6]} vs final_keys="
                     f"{sorted(final)[:6]}"
                 )
+        if overload and not model.failures:
+            _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         if disk_faults:
             faults.disarm_all()
@@ -671,6 +827,11 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     ap.add_argument("--disk-faults", action="store_true",
                     help="enable the seeded storage-nemesis dimension "
                          "(failpoint storms; WAL-backed logs on tpu_batch)")
+    ap.add_argument("--overload", action="store_true",
+                    help="build the backends with a small admission "
+                         "window and drive past it after the nemesis "
+                         "loop (asserts bounded latency + zero lost/"
+                         "duplicated acked commands)")
     grp = ap.add_mutually_exclusive_group()
     grp.add_argument("--restarts", dest="restarts", action="store_true",
                      default=None,
@@ -680,7 +841,8 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
                      help="force the restart dimension off")
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
-              restarts=args.restarts, disk_faults=args.disk_faults)
+              restarts=args.restarts, disk_faults=args.disk_faults,
+              overload=args.overload)
     print(f"ops={res.ops} consistent={res.consistent}")
     for f in res.failures:
         print("FAILURE:", f)

@@ -23,6 +23,7 @@ import zlib
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ra_tpu import faults
+from ra_tpu.utils.lib import retry
 
 MAGIC = b"RTS1"
 _HDR = struct.Struct("<4sI")
@@ -39,7 +40,11 @@ class SegmentWriterHandle:
         self.count = 0
         self.range: Optional[Tuple[int, int]] = None
         exists = os.path.exists(path)
-        self._f = open(path, "r+b" if exists else "w+b")
+        # transient open failures (EMFILE/EAGAIN bursts) retry with
+        # backoff — ra_file parity (reference: src/ra_file.erl:1-37);
+        # fsync failures stay poison and are never retried
+        self._f = retry(lambda: open(path, "r+b" if exists else "w+b"),
+                        attempts=3, delay_s=0.02)
         if not exists or os.path.getsize(path) < _HDR.size:
             self._f.write(_HDR.pack(MAGIC, max_count))
             self._f.write(b"\x00" * (_SLOT.size * max_count))
@@ -127,7 +132,10 @@ class SegmentReader:
         self.path = path
         self.compute_checksums = compute_checksums
         self.mode = mode
-        self._f = open(path, "rb")
+        # reader opens retry like the writer's (ra_file parity): sparse
+        # reads race compaction renames, and a transient EMFILE burst
+        # must not fail a read that would succeed a moment later
+        self._f = retry(lambda: open(path, "rb"), attempts=3, delay_s=0.02)
         magic, mc = _HDR.unpack(self._f.read(_HDR.size))
         if magic != MAGIC:
             raise ValueError(f"bad segment magic in {path}")

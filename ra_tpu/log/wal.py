@@ -431,9 +431,14 @@ class Wal:
                 self._fail(err)
                 return
             self.counter.incr("batches")
-            self.counter.incr("writes", n_entries)
+            # 'writes'/'batch_size' count QUEUE ITEMS (incl. truncate
+            # markers and dead-index-dropped writes) — the pre-run-record
+            # semantics dashboards may rely on; 'entries' counts the
+            # expanded log entries actually framed (runs widened)
+            self.counter.incr("writes", len(batch))
+            self.counter.incr("entries", n_entries)
             self.counter.incr("bytes_written", len(buf))
-            self.counter.put("batch_size", n_entries)
+            self.counter.put("batch_size", len(batch))
             self._bytes += len(buf)
         if self.notify_many is not None and len(written) > 1:
             # one transport/lock round for the whole batch's written

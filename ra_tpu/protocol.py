@@ -53,6 +53,11 @@ class Command(NamedTuple):
     # traffic and drained in bounded slices (reference: ra_ets_queue +
     # FLUSH_COMMANDS_SIZE, src/ra_server_proc.erl:160,507-530)
     priority: str = "normal"
+    # machine-internal must-deliver commands (timer fires, Append/
+    # TryAppend effects): fired exactly once with no retry path, so the
+    # admission window must never shed them (client commands are
+    # rejected/dropped instead — they have a caller or owe no ack)
+    internal: bool = False
 
 
 # -- snapshot metadata -----------------------------------------------------

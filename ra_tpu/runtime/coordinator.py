@@ -113,7 +113,7 @@ class GroupHost:
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
-        "specials", "last_ok_sent", "fresh_tail",
+        "specials", "last_ok_sent", "fresh_tail", "match_hint",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -210,6 +210,13 @@ class GroupHost:
         # log re-read: (first_idx, prev_term, term, [Entry, ...]).
         # Valid only within one step; _send_aers always clears it.
         self.fresh_tail: Optional[Tuple[int, int, int, list]] = None
+        # leader-side CONFIRMED replication point per slot (from AER
+        # success replies) — the host mirror the pipeline window is
+        # enforced against (next_index advances optimistically at send
+        # time; match_hint only on acks, mirroring the reference's
+        # match_index in its Next - Match <= ?MAX_PIPELINE_COUNT gate,
+        # src/ra_server.erl:2308-2329)
+        self.match_hint: List[int] = [0] * len(self.members)
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -242,6 +249,9 @@ class BatchCoordinator:
         send_msg_cb=None,
         mesh=None,
         active_set: str = "auto",
+        max_pipeline_count: int = 4096,
+        max_command_backlog: int = 4096,
+        command_deadline_s: float = 5.0,
     ):
         self.name = node_name
         self.capacity = capacity
@@ -252,6 +262,19 @@ class BatchCoordinator:
         self.idle_sleep_s = idle_sleep_s
         self.tick_interval_s = tick_interval_s
         self.send_msg_cb = send_msg_cb
+        # flow control: per-peer AER pipeline window (reference:
+        # ?MAX_PIPELINE_COUNT, src/ra_server.hrl:8), per-group client
+        # admission window against apply progress, and the command-lane
+        # watchdog deadline (accepted command with no commit progress
+        # for this long -> detected wedge, recovery, bounded failure)
+        self.max_pipeline_count = max_pipeline_count
+        self.max_command_backlog = max_command_backlog
+        self.command_deadline_s = command_deadline_s
+        from ra_tpu import counters as _counters
+
+        self.counters = _counters.new(
+            ("coordinator", node_name), _counters.COORDINATOR_FIELDS
+        )
         # activity-scaled stepping: "auto" runs the fused step over a
         # compact gather of just the groups with pending device work
         # whenever they number at most capacity/4 (power-of-two padded
@@ -332,6 +355,7 @@ class BatchCoordinator:
         self.running = True
         self.registry.register(node_name, self)
         self.steps = 0
+        self.sub_steps = 0  # steps taken on the active-set (sub) path
         self.msgs_processed = 0
 
         self._step_thread = threading.Thread(
@@ -445,6 +469,9 @@ class BatchCoordinator:
         self.running = False
         if self._started:
             self._step_thread.join(timeout=5)
+        from ra_tpu import counters as _counters
+
+        _counters.delete(("coordinator", self.name))
         for g in self.groups:
             if g is not None:
                 for t in g.machine_timers.values():
@@ -721,6 +748,7 @@ class BatchCoordinator:
                     name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)
                 }
                 self.steps += 1
+                self.sub_steps += 1
                 self.msgs_processed += len(consumed)
                 self._process_egress(eg, consumed, aer_dirty, act=act_np)
         else:
@@ -796,6 +824,10 @@ class BatchCoordinator:
                     g.last_ack[slot] = time.monotonic()
                     if msg.success:
                         g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
+                        if slot < len(g.match_hint):
+                            g.match_hint[slot] = max(
+                                g.match_hint[slot], msg.last_index
+                            )
                         vs = g.voter_status.get(slot)
                         if (
                             isinstance(vs, tuple)
@@ -915,6 +947,40 @@ class BatchCoordinator:
         me = (g.name, self.name)
         idx = log.next_index()
         first = idx
+        # admission window: bound the group's appended-but-unapplied
+        # backlog so a client cannot queue unbounded work ahead of apply
+        # progress (the client analog of the reference's per-peer
+        # pipeline window, src/ra_server.hrl:8). Commands past the
+        # window are rejected with backoff (from_ref callers see
+        # ("reject", "overloaded") and retry) or dropped and counted:
+        # noreply commands owe no ack, and notify-mode pipelined
+        # commands are at-most-once by contract (clients resend on a
+        # missing applied notification — reference pipeline_command
+        # semantics). Machine-INTERNAL commands (timer fires, Append
+        # effects) fire exactly once with no retry path: never shed.
+        room = self.max_command_backlog - (first - 1 - g.last_applied)
+        if room < len(cmds):
+            admit: List[Command] = []
+            shed: List[Command] = []
+            for cmd in cmds:
+                if cmd.internal or len(admit) < room:
+                    admit.append(cmd)
+                else:
+                    shed.append(cmd)
+            cmds = admit
+            n_rej = 0
+            for cmd in shed:
+                if cmd.from_ref is not None:
+                    n_rej += 1
+                    self._reply(cmd.from_ref, ("reject", "overloaded"))
+            if n_rej:
+                self.counters.incr("commands_rejected", n_rej)
+            if len(shed) > n_rej:
+                self.counters.incr(
+                    "commands_dropped_overload", len(shed) - n_rej
+                )
+            if not cmds:
+                return
         # fast path: plain user commands owing no replies (the pipeline
         # shape) — build the run in one pass and bulk-append it
         simple = True
@@ -1020,11 +1086,13 @@ class BatchCoordinator:
         for i, m in enumerate(g.members):
             if m is None:
                 g.last_ack.pop(i, None)  # fresh occupant, fresh liveness
+                g.match_hint[i] = 0  # nothing confirmed for the newcomer
                 return i  # reuse a tombstoned slot
         if len(g.members) < self.P:
             g.members.append(None)
             g.next_index.append(1)
             g.commit_sent.append(0)
+            g.match_hint.append(0)
             return len(g.members) - 1
         return None
 
@@ -1082,6 +1150,7 @@ class BatchCoordinator:
                     g.voter_status = {i: "voter" for i in range(len(new))}
                     g.next_index = [1] * len(new)
                     g.commit_sent = [0] * len(new)
+                    g.match_hint = [0] * len(new)
                     self.state = self.state._replace(
                         self_slot=self.state.self_slot.at[g.gid].set(g.self_slot)
                     )
@@ -1352,12 +1421,20 @@ class BatchCoordinator:
                         )
                 elif sr_l[p] and from_sid is not None:
                     if t is RequestVoteRpc:
+                        if succ_l[p]:
+                            # granting a vote resets the election timer
+                            # (Raft §3.4): the granter must give its
+                            # candidate a full round before campaigning
+                            # itself, or dueling candidacies ping-pong
+                            g.last_contact = time.monotonic()
                         queue_send(
                             from_sid,
                             RequestVoteResult(term_l[p], bool(succ_l[p])),
                             (g.name, self.name),
                         )
                     elif t is PreVoteRpc:
+                        if succ_l[p]:
+                            g.last_contact = time.monotonic()
                         queue_send(
                             from_sid,
                             PreVoteResult(term_l[p], msg.token, bool(succ_l[p])),
@@ -1404,16 +1481,16 @@ class BatchCoordinator:
                     # window (a just-deposed leader must give the new
                     # one a chance to make contact before suspecting)
                     g.last_contact = now_roles
-                if (
-                    g.pending_queries
-                    and g.role == C.R_LEADER
-                    and new_role != C.R_LEADER
-                ):
+                if g.role == C.R_LEADER and new_role != C.R_LEADER:
                     # deposed: in-flight linearizable reads must not be
-                    # answered from this replica's state
+                    # answered from this replica's state, and pending
+                    # command futures must redirect rather than hang
+                    # their clients until timeout
                     for q in g.pending_queries:
                         self._reply(q["fut"], ("redirect", None))
                     g.pending_queries = []
+                    g.leader_slot = leader_l[p]  # hint before the sweep
+                    self._fail_pending(g)
                 g.role = new_role
                 g.term = gterm_l[p]
                 g.leader_slot = leader_l[p]
@@ -1494,6 +1571,11 @@ class BatchCoordinator:
                 # invalidated — its (sid, term, ack) invariant only
                 # holds while acked entries are never truncated
                 g.last_ok_sent = None
+                # pending futures for truncated indexes are provably
+                # dead (the entries are being overwritten): redirect
+                # their clients to the new leader now — a clean
+                # "redirect" verdict, safe to retry exactly-once
+                self._fail_pending(g, from_idx=first_idx, verdict="redirect")
                 if g.specials and g.specials[-1] >= first_idx:
                     g.specials = [s for s in g.specials if s < first_idx]
                 if g.cluster_history:
@@ -1586,6 +1668,7 @@ class BatchCoordinator:
         li, _ = g.log.last_index_term()
         g.next_index = [li + 1] * len(g.members)
         g.commit_sent = [0] * len(g.members)
+        g.match_hint = [0] * len(g.members)
         g.last_ack = {}
         g.leader_slot = g.self_slot
         leaderboard.record(g.cluster_name, (g.name, self.name), tuple(g.members))
@@ -1785,7 +1868,8 @@ class BatchCoordinator:
                     (g.name, self.name),
                     Command(kind=USR, data=eff.cmd,
                             reply_mode=eff.reply_mode,
-                            from_ref=eff.from_ref if is_leader else None),
+                            from_ref=eff.from_ref if is_leader else None,
+                            internal=True),
                     None,
                 )
 
@@ -1812,7 +1896,8 @@ class BatchCoordinator:
             if self.running and g.role == C.R_LEADER:
                 self.deliver(
                     (g.name, self.name),
-                    Command(kind=USR, data=("timeout", eff.name)),
+                    Command(kind=USR, data=("timeout", eff.name),
+                            internal=True),
                     None,
                 )
 
@@ -1820,6 +1905,40 @@ class BatchCoordinator:
         t.daemon = True
         t.start()
         g.machine_timers[eff.name] = t
+
+    def _fail_pending(self, g: GroupHost, counter: str = "pending_redirected",
+                      from_idx: int = 0, verdict: str = "maybe") -> None:
+        """Answer pending await_consensus futures instead of silently
+        dropping them (root cause of the round-5 command wedge: a leader
+        deposed between append and commit popped its pending futures on
+        apply without replying, hanging every waiting client for its
+        full timeout).
+
+        The verdict matters for exactly-once semantics:
+        - ``"redirect"`` — the entry is provably DEAD (truncated away):
+          clients may retry with no duplicate risk;
+        - ``"maybe"`` (default) — deposed with the entry still in the
+          log: it MAY commit under the new leader. process_command
+          surfaces this as an immediate error unless the caller opted
+          into at-least-once retries — a transparent retry here is how
+          the overload harness caught a double-applied incr.
+
+        ``from_idx`` limits the sweep to truncated indexes; 0 fails
+        all."""
+        pending = g.pending_replies
+        if not pending:
+            return
+        leader = g.sid_of(g.leader_slot)
+        if leader == (g.name, self.name):
+            leader = None  # never redirect a caller back to ourselves
+        doomed = (
+            list(pending) if from_idx <= 0
+            else [i for i in pending if i >= from_idx]
+        )
+        for i in doomed:
+            self._reply(pending.pop(i), (verdict, leader))
+        if doomed:
+            self.counters.incr(counter, len(doomed))
 
     # -- outbound ----------------------------------------------------------
 
@@ -1878,6 +1997,7 @@ class BatchCoordinator:
 
     def _send_aers(self, aer_dirty) -> None:
         outbound: Dict[str, List] = {}
+        now = time.monotonic()
         for gid in aer_dirty:
             g = self.groups[gid]
             if g is None:
@@ -1898,6 +2018,48 @@ class BatchCoordinator:
                 nxt = g.next_index[s]
                 if nxt > li and commit <= g.commit_sent[s]:
                     continue  # nothing new to say
+                if nxt <= li:
+                    # per-peer pipeline window: never run more than
+                    # max_pipeline_count entries ahead of the peer's
+                    # CONFIRMED match (reference: Next - Match <=
+                    # ?MAX_PIPELINE_COUNT, src/ra_server.erl:2308-2329).
+                    # An actively-acking peer reopens the window by
+                    # itself; a silent one gets an EMPTY probe at the
+                    # current next point (the actor backend's
+                    # empty-probe shape): its success ack rebuilds
+                    # match_hint at the peer's true tail, its reject
+                    # hint rewinds next_index — either resynchronizes
+                    # without blindly re-sending the whole log (a fresh
+                    # leader starts at match_hint 0, so a rewind-to-
+                    # match here would re-replicate or snapshot-stream
+                    # to every caught-up peer).
+                    mh = g.match_hint[s] if s < len(g.match_hint) else 0
+                    if nxt - mh > self.max_pipeline_count:
+                        la = g.last_ack.get(s)
+                        if la is not None and now - la <= self.tick_interval_s:
+                            continue  # window full but acks are flowing
+                        g.last_ack[s] = now  # one probe per tick per peer
+                        self.counters.incr("stale_peer_resends")
+                        prev_idx = nxt - 1
+                        prev_term = g.log.fetch_term(prev_idx)
+                        snap = g.log.snapshot_index_term()
+                        if prev_term is None or (
+                            snap is not None and prev_idx < snap[0]
+                        ):
+                            self._start_snapshot_sender(g, member)
+                            continue
+                        outbound.setdefault(member[1], []).append((
+                            member,
+                            AppendEntriesRpc(
+                                term=g.term, leader_id=sid,
+                                prev_log_index=prev_idx,
+                                prev_log_term=prev_term,
+                                leader_commit=commit, entries=(),
+                            ),
+                            sid,
+                        ))
+                        g.commit_sent[s] = commit
+                        continue
                 rpc = rpc_cache.get(nxt)
                 if rpc is None and ft is not None and nxt >= ft[0]:
                     # steady state: the entries were appended by THIS
@@ -2052,6 +2214,30 @@ class BatchCoordinator:
             self._reply(fut, ("ok", None))
             self._send_batch(target[1], [(target, TimeoutNow(), me)])
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "lane_recover":
+            # watchdog strike 1: force a device re-step (fresh quorum
+            # scan over current match/written state) and probe every
+            # peer — their acks or reject hints resynchronize
+            # replication from the confirmed point
+            self.counters.incr("lane_recoveries")
+            self._hot.add(g.gid)
+            if g.role == C.R_LEADER:
+                now = time.monotonic()
+                for s, m in enumerate(g.members):
+                    if (
+                        m is not None and s != g.self_slot
+                        and s < len(g.commit_sent)
+                    ):
+                        g.commit_sent[s] = -1
+                        g.last_ack.setdefault(s, now)
+                self._send_aers({g.gid})
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "lane_fail":
+            # watchdog second strike: recovery did not move the lane —
+            # bound the failure so clients retry elsewhere instead of
+            # hanging until their timeout
+            self._fail_pending(g, counter="lane_redirects")
+            return
         if isinstance(msg, tuple) and msg and msg[0] == "resync":
             if g.role == C.R_LEADER:
                 now = time.monotonic()
@@ -2116,6 +2302,7 @@ class BatchCoordinator:
             g.self_slot = 0
             g.next_index = [idx + 1]
             g.commit_sent = [0]
+            g.match_hint = [0]
             g.voter_status = {0: "voter"}
             g.last_ack = {}
             g.cluster_change_permitted = True
@@ -2155,6 +2342,10 @@ class BatchCoordinator:
                 slot = g.slot_of(to)
                 if slot >= 0:
                     g.next_index[slot] = max(g.next_index[slot], result.last_index + 1)
+                    if slot < len(g.match_hint):
+                        g.match_hint[slot] = max(
+                            g.match_hint[slot], result.last_index
+                        )
                     # feed the result through the device path for match
                     g.inbox.append((to, AppendEntriesReply(
                         result.term, True, result.last_index + 1,
@@ -2272,15 +2463,20 @@ class BatchCoordinator:
         """Adopt a higher term seen outside the device mailbox (call
         sites hold the state lock): revert to follower on host AND
         device, persist the term, drop in-flight linearizable reads."""
-        if g.role == C.R_LEADER and g.pending_queries:
+        if g.role == C.R_LEADER:
             for q in g.pending_queries:
                 self._reply(q["fut"], ("redirect", None))
             g.pending_queries = []
         bumped = term > g.term
         g.term = max(g.term, term)
+        was_leader = g.role == C.R_LEADER
         g.role = C.R_FOLLOWER
         g.last_contact = time.monotonic()
         g.leader_slot = g.slot_of(leader_sid) if leader_sid is not None else -1
+        if was_leader:
+            # deposed outside the device mailbox: same redirect contract
+            # as the egress role-transition path
+            self._fail_pending(g)
         if bumped and self.meta is not None:
             # entering a new term clears the durable vote (the device
             # mailbox path resets voted_for on term bumps identically)
@@ -2409,6 +2605,9 @@ class BatchCoordinator:
         g.last_applied = max(g.last_applied, meta.index)
         g.snap_floor = max(g.snap_floor, meta.index)
         g.last_ok_sent = None  # log identity changed under the ack key
+        # installing a snapshot forces follower: any leftover pending
+        # command futures must redirect, not hang
+        self._fail_pending(g)
         if g.specials:
             g.specials = [s for s in g.specials if s > meta.index]
         # adopt the snapshot's member set (node-local slot coordinates)
@@ -2421,6 +2620,7 @@ class BatchCoordinator:
                 g.voter_status = {i: "voter" for i in range(len(new))}
                 g.next_index = [meta.index + 1] * len(new)
                 g.commit_sent = [0] * len(new)
+                g.match_hint = [0] * len(new)
                 g.last_ack = {}
                 self.state = self.state._replace(
                     self_slot=self.state.self_slot.at[g.gid].set(g.self_slot)
@@ -2494,12 +2694,22 @@ class BatchCoordinator:
 
     def _detect_loop(self) -> None:
         cooldown: Dict[int, float] = {}
+        # suspicion arming: first sighting arms a randomized deadline
+        # (the textbook randomized election timeout); the election only
+        # fires if the group is STILL suspicious at the deadline. Breaks
+        # dueling candidacies: the node whose trigger lands first gets a
+        # full round before rivals pile in.
+        armed: Dict[int, float] = {}
+        # command-lane watchdog state per gid:
+        # (applied_seen, oldest_pending_idx, since, strikes)
+        lane_watch: Dict[int, Tuple[int, int, float, int]] = {}
         last_tick = time.monotonic()
         while self.running:
             try:
                 now0 = time.monotonic()
                 if now0 - last_tick >= self.tick_interval_s:
                     last_tick = now0
+                    self._lane_watchdog(lane_watch, now0)
                     ms = int(time.time() * 1000)
                     for i in range(self.n_groups):
                         g = self.groups[i]
@@ -2540,11 +2750,15 @@ class BatchCoordinator:
                 #   1. a stalled election (pre-vote/candidate whose
                 #      messages were lost) — mirror the actor backend's
                 #      state-enter election timer;
-                #   2. a follower with a known leader: dead leader node
-                #      fires immediately; an alive-but-silent one (a
-                #      deposed leader that never re-won) times out on
-                #      lost contact — the resync probe guarantees a live
-                #      leader contacts every peer within ~2 ticks;
+                #   2. a follower with a known leader: a dead leader
+                #      node counts once the follower has ALSO been
+                #      without contact for one election timeout (vote
+                #      grants refresh contact, so a member that just
+                #      endorsed a campaigning rival holds off); an
+                #      alive-but-silent leader (deposed, never re-won)
+                #      times out on lost contact — the resync probe
+                #      guarantees a live leader contacts every peer
+                #      within ~2 ticks;
                 #   3. a follower with NO known leader (term bumped by a
                 #      failed election) — contact timeout, gated on
                 #      term > 0 so fresh clusters still boot quiet until
@@ -2569,24 +2783,84 @@ class BatchCoordinator:
                             now - g.last_contact > 2 * self.election_timeout_s
                         )
                     elif leader is not None and leader[1] != self.name:
+                        # a dead leader node is suspicious only once it
+                        # has also been SILENT for an election timeout:
+                        # last_contact refreshes on vote grants, so a
+                        # member that just endorsed a campaigning rival
+                        # holds off instead of racing it (the round-5
+                        # takeover duel)
                         suspicious = (
                             not self.transport.node_alive(leader[1])
-                            or now - g.last_contact > contact_window
-                        )
+                            and now - g.last_contact > self.election_timeout_s
+                        ) or now - g.last_contact > contact_window
                     else:
                         suspicious = (
                             g.term > 0
                             and now - g.last_contact > contact_window
                         )
-                    if suspicious and now >= cooldown.get(i, 0.0):
-                        cooldown[i] = (
-                            now + 2 * self.election_timeout_s
-                            + random.random() * 2 * self.election_timeout_s
-                        )
-                        self.deliver((g.name, self.name), ElectionTimeout(), None)
+                    if not suspicious:
+                        armed.pop(i, None)
+                    elif now >= cooldown.get(i, 0.0):
+                        dl = armed.get(i)
+                        if dl is None:
+                            armed[i] = now + self.election_timeout_s * (
+                                0.1 + random.random()
+                            )
+                        elif now >= dl:
+                            armed.pop(i, None)
+                            cooldown[i] = (
+                                now + 2 * self.election_timeout_s
+                                + random.random() * 2 * self.election_timeout_s
+                            )
+                            self.deliver(
+                                (g.name, self.name), ElectionTimeout(), None
+                            )
             except Exception:  # noqa: BLE001
                 pass
             time.sleep(self._detector_poll_s)
+
+    def _lane_watchdog(
+        self, lane_watch: Dict[int, Tuple[int, int, float, int]], now0: float
+    ) -> None:
+        """Per-command-deadline lane watchdog (runs on the detector
+        thread, once per tick): a group holding pending client futures
+        whose apply floor AND oldest pending index both sat still for
+        ``command_deadline_s`` is a wedged lane. Strike 1 recovers
+        (device re-step + peer resync probe); a further strike bounds
+        the failure by redirecting the stuck clients. Turns the round-5
+        class of bug (accepted command, no commit, silent 10 s client
+        hang) into a detected, counted, bounded event."""
+        for i in range(self.n_groups):
+            g = self.groups[i]
+            if g is None:
+                continue
+            pending = g.pending_replies
+            if not pending:
+                lane_watch.pop(i, None)
+                continue
+            try:
+                oldest = min(pending)
+            except (ValueError, RuntimeError):
+                continue  # raced the step thread's mutation: next tick
+            st = lane_watch.get(i)
+            if st is None or st[0] != g.last_applied or st[1] != oldest:
+                lane_watch[i] = (g.last_applied, oldest, now0, 0)
+                continue
+            if now0 - st[2] <= self.command_deadline_s:
+                continue
+            strikes = st[3] + 1
+            lane_watch[i] = (g.last_applied, oldest, now0, strikes)
+            self.counters.incr("lane_wedges")
+            logger.warning(
+                "coordinator %s: command lane wedged for group %s "
+                "(oldest pending idx %d, applied %d, role %d, strike %d)",
+                self.name, g.name, oldest, g.last_applied, g.role, strikes,
+            )
+            self.deliver(
+                (g.name, self.name),
+                ("lane_recover",) if strikes == 1 else ("lane_fail",),
+                None,
+            )
 
     def _on_node_down(self, node_name: str) -> None:
         for i in range(self.n_groups):

@@ -171,7 +171,7 @@ class RaNode:
     # ?MUTABLE_CONFIG_KEYS, src/ra_server_sup_sup.erl:12-21)
     MUTABLE_CONFIG_KEYS = frozenset(
         {"machine_config", "max_pipeline_count", "max_aer_batch_size",
-         "machine_upgrade_strategy"}
+         "max_command_backlog", "machine_upgrade_strategy"}
     )
 
     def start_server(
@@ -239,6 +239,10 @@ class RaNode:
                     "max_aer_batch_size",
                     self.config.default_max_append_entries_rpc_batch_size,
                 ),
+                max_command_backlog=extra.get(
+                    "max_command_backlog",
+                    self.config.default_max_command_backlog,
+                ),
                 machine_config=machine_config,
                 machine_upgrade_strategy=extra.get(
                     "machine_upgrade_strategy",
@@ -286,7 +290,7 @@ class RaNode:
             _extra_cfg={
                 k: rec[k]
                 for k in ("max_pipeline_count", "max_aer_batch_size",
-                          "machine_upgrade_strategy")
+                          "max_command_backlog", "machine_upgrade_strategy")
                 if k in rec
             },
         )
@@ -476,6 +480,7 @@ class RaNode:
                     _extra_cfg={
                         k: rec[k]
                         for k in ("max_pipeline_count", "max_aer_batch_size",
+                                  "max_command_backlog",
                                   "machine_upgrade_strategy")
                         if k in rec
                     },

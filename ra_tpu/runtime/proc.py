@@ -469,7 +469,7 @@ class ServerProc:
                 if self.server.role == LEADER:
                     appends.append(Command(
                         kind=USR, data=eff.cmd, reply_mode=eff.reply_mode,
-                        from_ref=eff.from_ref,
+                        from_ref=eff.from_ref, internal=True,
                     ))
             elif isinstance(eff, fx.TryAppend):
                 # attempted in ANY raft state; a non-leader's command
@@ -483,6 +483,7 @@ class ServerProc:
                     from_ref=(
                         eff.from_ref if self.server.role == LEADER else None
                     ),
+                    internal=True,
                 ))
         # front-enqueue in reverse so the mailbox reads in emission order
         for cmd in reversed(appends):
@@ -614,7 +615,8 @@ class ServerProc:
             if self.running and self.server.role == LEADER:
                 from ra_tpu.protocol import USR
 
-                self.enqueue(Command(kind=USR, data=("timeout", eff.name)))
+                self.enqueue(Command(kind=USR, data=("timeout", eff.name),
+                                     internal=True))
 
         self._machine_timers[eff.name] = self.timers.after(eff.ms / 1000.0, fire)
 

@@ -203,6 +203,11 @@ class ServerConfig:
     initial_members: Tuple[ServerId, ...] = ()
     max_pipeline_count: int = 4096
     max_aer_batch_size: int = 128
+    # client admission window: appended-but-unapplied backlog above
+    # which new client commands are rejected ("reject", "overloaded")
+    # or, when ack-free, dropped — bounded queueing instead of silent
+    # unbounded latency (the client analog of max_pipeline_count)
+    max_command_backlog: int = 4096
     counters_enabled: bool = True
     # pre_vote on by default; candidates skip straight to request_vote
     # when False.
@@ -502,11 +507,19 @@ class Server:
             self._held_from_leader = False
         if stepping_down:
             # stepping down for real: outstanding client replies will
-            # never be issued by us — drop the handles so callers time
-            # out/retry, and clear snapshot-transfer statuses so a
-            # later election does not find peers stranded in
-            # sending/backoff with no sender or timer behind them
-            self.pending_replies.clear()
+            # never be issued by us — redirect the callers to the new
+            # leader (hint may be None) so they retry immediately
+            # instead of hanging out their full timeout, and clear
+            # snapshot-transfer statuses so a later election does not
+            # find peers stranded in sending/backoff with no sender or
+            # timer behind them. The command MAY still commit if the
+            # entry survives on the new leader, so the verdict is
+            # "maybe": an immediate error to plain callers, a retry
+            # target only for callers that opted into at-least-once.
+            hint = self.leader_id if self.leader_id != self.id else None
+            for fut in self.pending_replies.values():
+                effects.append(Reply(fut, ("maybe", hint)))
+            self.pending_replies = {}
             self.pending_queries = []
             for p in self.cluster.values():
                 if status_kind(p.status) in ("sending_snapshot", "snapshot_backoff"):
@@ -659,10 +672,32 @@ class Server:
             return self._leader_control(msg, effects)
         return effects
 
-    def _append_leader(self, cmd: Command, effects: EffectList) -> None:
+    def _append_leader(self, cmd: Command, effects: EffectList,
+                       exempt: bool = False) -> None:
         """Append a command to the leader's log, handling membership
         commands and reply-after-append modes (reference:
-        append_log_leader src/ra_server.erl:3485-3550)."""
+        append_log_leader src/ra_server.erl:3485-3550). ``exempt``
+        bypasses the admission window for internal must-deliver appends
+        (fired exactly once with no retry path, e.g. monitor
+        down/nodedown events)."""
+        if cmd.kind != NOOP and not exempt and not cmd.internal:
+            # admission window: bound the appended-but-unapplied backlog
+            # (noops and machine-internal commands bypass — the commit
+            # gate must never be starved, and timer fires / Append
+            # effects fire exactly once with no retry path). Rejected
+            # callers back off and retry; noreply commands owe no ack;
+            # notify-mode pipelined commands are at-most-once by
+            # contract (clients resend on a missing applied
+            # notification, reference pipeline_command semantics) —
+            # drops are counted either way
+            backlog = self.log.next_index() - 1 - self.last_applied
+            if backlog >= self.cfg.max_command_backlog:
+                if cmd.from_ref is not None:
+                    self._c("commands_rejected")
+                    effects.append(Reply(cmd.from_ref, ("reject", "overloaded")))
+                else:
+                    self._c("commands_dropped_overload")
+                return
         if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
             if not self._append_cluster_cmd(cmd, effects):
                 return
@@ -909,6 +944,31 @@ class Server:
                 # pending locally (quorum-strategy clusters stop probing
                 # a legitimately-old minority once the bump lands)
                 effects.append(SendRpc(sid, InfoRpc(self.current_term, self.id)))
+        # stale-peer re-send: a peer a full pipeline window ahead of its
+        # confirmed match that made NO progress across two ticks cannot
+        # accept anything we would pipeline; rewind next_index to
+        # match + 1 so replication resumes from a point it can append
+        # (reference: stale peer handling around the pipeline window,
+        # src/ra_server.erl:2308-2329)
+        prev = getattr(self, "_stale_match", None)
+        if prev is None:
+            prev = self._stale_match = {}
+        for sid, p in self.peers().items():
+            if (
+                status_kind(p.status) == "normal"
+                and p.next_index - p.match_index > self.cfg.max_pipeline_count
+            ):
+                # match 0 means nothing confirmed THIS term (fresh
+                # leader): never rewind to 1 — that would re-send the
+                # whole log (or stream snapshots) to caught-up peers;
+                # the tick's empty probe elicits the reject hint that
+                # rewinds next_index to the peer's true position
+                if prev.get(sid) == p.match_index and p.match_index > 0:
+                    p.next_index = p.match_index + 1
+                    self._c("stale_peer_resends")
+                prev[sid] = p.match_index
+            else:
+                prev.pop(sid, None)
         self._maybe_upgrade_machine(effects)
         self._pipeline(effects, force_commit_sync=True)
         return effects
@@ -959,10 +1019,14 @@ class Server:
                         continue
                     p.status = "disconnected" if msg.status == "down" else "normal"
             data = ("nodeup", msg.node) if msg.status == "up" else ("nodedown", msg.node)
-            self._append_leader(Command(kind=USR, data=data), effects)
+            # node/monitor events fire exactly once with no retry path:
+            # they must never be shed by the admission window
+            self._append_leader(Command(kind=USR, data=data), effects,
+                                exempt=True)
         else:  # DownEvent
             self._append_leader(
-                Command(kind=USR, data=("down", msg.target, msg.info)), effects
+                Command(kind=USR, data=("down", msg.target, msg.info)), effects,
+                exempt=True,
             )
         self._pipeline(effects)
         return effects
@@ -1992,6 +2056,13 @@ class Server:
         return effects
 
     def _exit_condition(self, role: str, effects: EffectList) -> None:
+        if role == LEADER and getattr(self, "_hold_entry_term", None) not in (
+            None, self.current_term,
+        ):
+            # the term advanced while we held (a higher-term probe was
+            # adopted mid-hold): resuming leadership would be a stale-
+            # term leader — fall back to follower instead
+            role = FOLLOWER
         if role == LEADER:
             # returning to leadership after a hold (transfer timed out /
             # WAL recovered) re-enters WITHOUT the fresh-election reset:
@@ -2006,6 +2077,9 @@ class Server:
     def await_condition(self, cond: Condition, effects: EffectList) -> None:
         self.condition = cond
         self.condition_generation += 1
+        # release-time guard: a hold that would resume leadership may
+        # only do so in the term it was entered (see _exit_condition)
+        self._hold_entry_term = self.current_term
         self._become(AWAIT_CONDITION, effects)
 
     def _on_wal_down(self) -> EffectList:
@@ -2032,7 +2106,19 @@ class Server:
                 and m.evt[0] == "wal_up"
             )
 
-        self.await_condition(Condition(predicate=wal_is_up), effects)
+        # a leader whose WAL comes back in the SAME term resumes
+        # leadership directly (the abdication TimeoutNow may have been
+        # lost; a successful transfer shows up as a higher-term probe
+        # during the hold, and the _exit_condition term guard then
+        # forces follower). A hold that times out with the WAL still
+        # dead always falls back to follower.
+        self.await_condition(
+            Condition(
+                predicate=wal_is_up,
+                transition_to=LEADER if self.role == LEADER else FOLLOWER,
+            ),
+            effects,
+        )
         return effects
 
     # ------------------------------------------------------------------

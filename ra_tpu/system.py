@@ -83,6 +83,9 @@ class SystemConfig:
     segment_compute_checksums: bool = True
     snapshot_chunk_size: int = SNAPSHOT_CHUNK_SIZE
     default_max_pipeline_count: int = DEFAULT_MAX_PIPELINE_COUNT
+    # client admission window (appended-but-unapplied backlog cap per
+    # group; see docs/INTERNALS.md §12 flow control)
+    default_max_command_backlog: int = DEFAULT_MAX_PIPELINE_COUNT
     default_max_append_entries_rpc_batch_size: int = DEFAULT_AER_BATCH_SIZE
     min_snapshot_interval: int = MIN_SNAPSHOT_INTERVAL
     min_checkpoint_interval: int = MIN_CHECKPOINT_INTERVAL

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Flake gate: the slow job that runs AFTER the tier-1 gate.
+#
+# Repeats the liveness-sensitive tests 20x across all three active_set
+# stepping modes (tests/test_flake_gate.py), then loops the whole
+# deterministic command-lane regression file. An intermittent liveness
+# bug (the round-5 active-set command wedge failed ~1 run in 3) cannot
+# pass 20 consecutive repetitions; a single tier-1 pass proves nothing
+# about it.
+#
+# Usage: scripts/flake_gate.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== flake gate: 20x soaks (3 active_set modes) =="
+python -m pytest tests/test_flake_gate.py -q -m flake_gate \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "== flake gate: command-lane regression file x20 =="
+for i in $(seq 1 20); do
+    python -m pytest tests/test_command_lane.py -q \
+        -p no:cacheprovider -p no:randomly -x >/tmp/flake_gate_lane.log 2>&1 \
+        || { echo "regression loop failed on iteration $i"; \
+             tail -30 /tmp/flake_gate_lane.log; exit 1; }
+done
+echo "flake gate: PASS"

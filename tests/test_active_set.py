@@ -110,10 +110,83 @@ def _run_cluster(mode, prefix, groups=6, cmds=17):
             c.stop()
 
 
-@pytest.mark.parametrize("mode", ["always", "never"])
+@pytest.mark.parametrize("mode", ["always", "never", "auto"])
 def test_cluster_parity_across_step_modes(mode):
+    # "auto" — the shipped default — is in the matrix since round 6:
+    # the round-5 wedge shipped precisely because no test ran it
     out = _run_cluster(mode, f"as_{mode[:2]}")
     assert out["g0_state"] == out["total"] + 100
+
+
+def test_auto_mode_flip_soak_crosses_saturation_boundary():
+    """Drive an "auto" cluster across the capacity/4 saturation
+    boundary in BOTH directions: a hot set wider than capacity >> 2
+    forces full-width steps, a narrow one re-engages the sub path, then
+    wide again — the sub<->full transitions and the hot-set carryover
+    across them must not lose or wedge any command
+    (coordinator.py active-set selection; VERDICT r5 item 5)."""
+    groups = 24  # capacity 32 -> threshold 8: 24 saturates, 3 does not
+    coords = [
+        BatchCoordinator(f"fs{i}", capacity=32, num_peers=3,
+                         active_set="auto", election_timeout_s=0.05,
+                         detector_poll_s=0.02)
+        for i in range(3)
+    ]
+    try:
+        for c in coords:
+            c.start()
+        members = lambda g: [(f"g{g}", f"fs{i}") for i in range(3)]  # noqa: E731
+        for c in coords:
+            c.add_groups(
+                [(f"g{g}", f"cl{g}", members(g), adder()) for g in range(groups)]
+            )
+        for g in range(groups):
+            coords[0].deliver((f"g{g}", "fs0"), ElectionTimeout(), None)
+        await_(
+            lambda: all(
+                coords[0].by_name[f"g{g}"].role == C.R_LEADER
+                for g in range(groups)
+            ),
+            what="leaders (flip soak)",
+        )
+
+        def burst(gids, k):
+            futs = []
+            for _ in range(k):
+                for g in gids:
+                    fut = api.Future()
+                    coords[0].deliver(
+                        (f"g{g}", "fs0"),
+                        Command(kind=USR, data=1,
+                                reply_mode="await_consensus", from_ref=fut),
+                        None,
+                    )
+                    futs.append(fut)
+            for fut in futs:
+                tag, _val, _ = fut.result(timeout=30)
+                assert tag == "ok"
+
+        expect = [0] * groups
+        for phase, gids in enumerate(
+            [range(groups), range(3), range(groups), range(4, 7),
+             range(groups)]
+        ):
+            burst(list(gids), 5)
+            for g in gids:
+                expect[g] += 5
+        await_(
+            lambda: all(
+                coords[0].by_name[f"g{g}"].machine_state == expect[g]
+                for g in range(groups)
+            ),
+            what="all applied after mode flips",
+        )
+        # both step paths actually ran on the leader coordinator
+        assert coords[0].sub_steps > 0, "sub path never engaged"
+        assert coords[0].steps > coords[0].sub_steps, "full path never engaged"
+    finally:
+        for c in coords:
+            c.stop()
 
 
 def test_active_set_sub_step_matches_full_step_kernel():

@@ -1259,3 +1259,213 @@ def test_hold_snapshot_result_higher_term_steps_down():
                                    last_term=lt), from_peer=S3)
     assert s.role == FOLLOWER
     assert s.current_term >= 6
+
+
+# ---------------------------------------------------------------------------
+# round 6: the follower_aer divergence/duplicate matrix (reference:
+# follower_aer_1..7 family, test/ra_server_SUITE.erl:23-147) — every
+# scenario asserts (role', state', effects) on the pure core
+
+
+def _seeded_follower(n=3, term=1):
+    """Follower with entries 1..n at `term` accepted from leader S2."""
+    s = mk(sid=S1)
+    effects = handle_all(
+        s, aer(term=term, prev=0, prev_term=0,
+               entries=[ent(i, term, i * 10) for i in range(1, n + 1)]),
+        from_peer=S2,
+    )
+    assert s.log.last_index_term() == (n, term)
+    assert s.role == FOLLOWER and s.leader_id == S2
+    return s, effects
+
+
+def test_follower_aer_duplicate_batch_is_idempotent():
+    # the exact same AER delivered twice (network retry): the second
+    # delivery re-acks success at the same tail and appends nothing
+    s, _ = _seeded_follower(3)
+    effects = handle_all(
+        s, aer(term=1, prev=0, prev_term=0,
+               entries=[ent(i, 1, i * 10) for i in range(1, 4)]),
+        from_peer=S2,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and replies[0].success
+    assert replies[0].last_index == 3
+    assert s.log.last_index_term() == (3, 1)
+    assert s.role == FOLLOWER and s.current_term == 1
+
+
+def test_follower_aer_overlapping_prefix_appends_only_new_suffix():
+    # AER overlapping an already-held same-term prefix: only the new
+    # suffix is appended; existing entries are NOT rewritten
+    s, _ = _seeded_follower(3)
+    before = s.log.fetch(2).cmd.data
+    effects = handle_all(
+        s, aer(term=1, prev=1, prev_term=1,
+               entries=[ent(2, 1, 20), ent(3, 1, 30),
+                        ent(4, 1, 40), ent(5, 1, 50)]),
+        from_peer=S2,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and replies[0].success
+    assert s.log.last_index_term() == (5, 1)
+    assert s.log.fetch(2).cmd.data == before  # untouched prefix
+
+
+def test_follower_aer_divergent_suffix_truncated_and_overwritten():
+    # a new term's leader overwrites the follower's uncommitted suffix:
+    # divergent entries 2..3 (term 1) are truncated and replaced by the
+    # term-2 entries; the tail reflects the NEW batch exactly
+    s, _ = _seeded_follower(3)
+    effects = handle_all(
+        s, aer(term=2, leader=S3, prev=1, prev_term=1,
+               entries=[ent(2, 2, 999)]),
+        from_peer=S3,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and replies[0].success
+    assert s.log.last_index_term() == (2, 2)
+    assert s.log.fetch(2).cmd.data == 999
+    assert s.log.fetch_term(3) is None  # truncated away
+    assert s.current_term == 2 and s.leader_id == S3
+
+
+def test_follower_aer_stale_shorter_duplicate_does_not_rewind():
+    # an OLD duplicate covering a shorter prefix arrives after a longer
+    # accept (reordered network): success ack, tail must NOT rewind
+    s, _ = _seeded_follower(3)
+    effects = handle_all(
+        s, aer(term=1, prev=0, prev_term=0, entries=[ent(1, 1, 10)]),
+        from_peer=S2,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and replies[0].success
+    assert s.log.last_index_term() == (3, 1)
+
+
+def test_follower_aer_empty_heartbeat_advances_commit_and_applies():
+    s, _ = _seeded_follower(3)
+    assert s.commit_index == 0
+    handle_all(s, aer(term=1, prev=3, prev_term=1, commit=2), from_peer=S2)
+    assert s.commit_index == 2
+    assert s.last_applied == 2
+    assert s.machine_state == 10 + 20  # adder applied entries 1..2
+
+
+def test_follower_aer_commit_capped_by_own_tail():
+    # leader_commit beyond the follower's last entry: commit advances
+    # only to the local tail (Raft: min(leaderCommit, last new entry))
+    s, _ = _seeded_follower(3)
+    handle_all(s, aer(term=1, prev=3, prev_term=1, commit=100), from_peer=S2)
+    assert s.commit_index == 3
+    assert s.last_applied == 3
+
+
+def test_follower_aer_lower_term_rejected_state_unchanged():
+    s, _ = _seeded_follower(3, term=2)
+    effects = handle_all(
+        s, aer(term=1, prev=3, prev_term=2, entries=[ent(4, 1, 40)]),
+        from_peer=S3,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and not replies[0].success
+    assert replies[0].term == 2  # tells the stale leader its real term
+    assert s.log.last_index_term() == (3, 2)
+    assert s.current_term == 2 and s.role == FOLLOWER
+
+
+def test_follower_aer_gap_hints_local_tail():
+    # prev far beyond the local log: reject with a hint at the local
+    # tail so the leader rewinds in one hop, not one entry at a time
+    s, _ = _seeded_follower(2)
+    effects = handle_all(
+        s, aer(term=1, prev=10, prev_term=1, entries=[ent(11, 1, 1)]),
+        from_peer=S2,
+    )
+    replies = sent(effects, AppendEntriesReply)
+    assert replies and not replies[0].success
+    assert replies[0].next_index == 3  # local last + 1
+    assert s.log.last_index_term() == (2, 1)
+
+
+# ---------------------------------------------------------------------------
+# round 6: leader WAL-death abdication (reference: leader abdication on
+# wal_down, src/ra_server.erl:653-693 + await_condition hold/release)
+
+from ra_tpu.protocol import TimeoutNow
+
+
+def test_leader_wal_death_abdicates_to_most_caught_up_voter():
+    s = lead(mk(sid=S1))
+    s._append_leader(Command(USR, 1), [])
+    s._append_leader(Command(USR, 2), [])
+    li, lt = s.log.last_index_term()
+    # S2 confirmed further ahead than S3
+    handle_all(s, AppendEntriesReply(s.current_term, True, li + 1, li, lt),
+               from_peer=S2)
+    handle_all(s, AppendEntriesReply(s.current_term, True, li, li - 1, lt),
+               from_peer=S3)
+    effects = s.handle(LogEvent(("wal_down",)))
+    tn = [e for e in effects if isinstance(e, SendRpc)
+          and isinstance(e.msg, TimeoutNow)]
+    assert len(tn) == 1 and tn[0].to == S2  # the most caught-up voter
+    assert s.role == AWAIT_CONDITION
+
+
+def test_leader_wal_death_skips_nonvoter_for_transfer():
+    s = lead(mk(sid=S1))
+    s._append_leader(Command(USR, 1), [])
+    li, lt = s.log.last_index_term()
+    # S2 is ahead but a nonvoter: the transfer must go to voter S3
+    # promotion target far ahead: the ack must NOT auto-promote S2
+    s.cluster[S2].voter_status = ("nonvoter", 10**9)
+    handle_all(s, AppendEntriesReply(s.current_term, True, li + 1, li, lt),
+               from_peer=S2)
+    handle_all(s, AppendEntriesReply(s.current_term, True, li, li - 1, lt),
+               from_peer=S3)
+    effects = s.handle(LogEvent(("wal_down",)))
+    tn = [e for e in effects if isinstance(e, SendRpc)
+          and isinstance(e.msg, TimeoutNow)]
+    assert len(tn) == 1 and tn[0].to == S3
+    assert s.role == AWAIT_CONDITION
+
+
+def test_solo_leader_wal_death_holds_without_transfer():
+    s = make_server(S1, [S1], adder())
+    s.handle(ElectionTimeout())
+    assert s.role == LEADER  # single member self-elects
+    effects = s.handle(LogEvent(("wal_down",)))
+    assert not sent(effects, TimeoutNow)
+    assert s.role == AWAIT_CONDITION
+
+
+def test_wal_recovery_releases_hold_back_to_leader():
+    s = lead(mk(sid=S1))
+    pre_term = s.current_term
+    noop_gate = s.cluster_change_permitted
+    s.handle(LogEvent(("wal_down",)))
+    assert s.role == AWAIT_CONDITION
+    # commands arriving during the hold redirect, never strand
+    fut_box = []
+    s_effects = s.handle(Command(USR, 5, reply_mode="await_consensus",
+                                 from_ref=fut_box))
+    replies = [e for e in s_effects if isinstance(e, Reply)]
+    assert replies and replies[0].reply[0] == "redirect"
+    # WAL back: the hold releases STRAIGHT back to leadership in the
+    # same term, with no fresh-election reset and no new noop
+    li_before = s.log.last_index_term()[0]
+    s.handle(LogEvent(("wal_up",)))
+    assert s.role == LEADER
+    assert s.current_term == pre_term
+    assert s.log.last_index_term()[0] == li_before
+    assert s.cluster_change_permitted == noop_gate
+
+
+def test_follower_wal_death_holds_and_releases():
+    s, _ = _seeded_follower(2)
+    s.handle(LogEvent(("wal_down",)))
+    assert s.role == AWAIT_CONDITION
+    s.handle(LogEvent(("wal_up",)))
+    assert s.role == FOLLOWER
+    assert s.log.last_index_term() == (2, 1)

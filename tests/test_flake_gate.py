@@ -1,0 +1,51 @@
+"""Flake gate: 20x repetition soaks over the liveness-sensitive tests.
+
+The round-5 active-set command wedge shipped because the
+linearizability test was only run once per suite pass — an ~1/3
+intermittent failure sails through a single run. This gate repeats the
+two tests that exercise the wedged interleavings 20x per ``active_set``
+mode, per the round-6 acceptance bar ("20/20 consecutive runs under
+each of auto|always|never").
+
+Slow-marked (excluded from the tier-1 gate's ``-m 'not slow'``); CI
+runs it as its own job via ``scripts/flake_gate.sh``, which also loops
+the deterministic regression file.
+"""
+
+import pytest
+
+from test_linearizability import _run_injected_stale_read_scenario
+
+REPEATS = 20
+
+
+@pytest.mark.slow
+@pytest.mark.flake_gate
+@pytest.mark.parametrize("mode", ["auto", "always", "never"])
+def test_injected_stale_read_20x(mode):
+    for i in range(REPEATS):
+        try:
+            _run_injected_stale_read_scenario(mode)
+        except Exception as e:  # noqa: BLE001 — annotate the iteration
+            raise AssertionError(
+                f"flake gate: run {i + 1}/{REPEATS} failed under "
+                f"active_set={mode!r}: {e}"
+            ) from e
+
+
+@pytest.mark.slow
+@pytest.mark.flake_gate
+@pytest.mark.parametrize("mode", ["auto", "always", "never"])
+def test_deposed_leader_regression_20x(mode):
+    from test_command_lane import (
+        test_deposed_leader_redirects_pending_commands,
+    )
+
+    for i in range(REPEATS):
+        try:
+            test_deposed_leader_redirects_pending_commands(mode)
+        except Exception as e:  # noqa: BLE001
+            raise AssertionError(
+                f"flake gate: regression run {i + 1}/{REPEATS} failed "
+                f"under active_set={mode!r}: {e}"
+            ) from e

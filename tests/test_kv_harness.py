@@ -143,3 +143,35 @@ def test_kv_harness_batch_backend_randomized(seed):
                          rescue=False)
     assert res.consistent, res.failures
     assert res.ops.get("put", 0) > 0
+
+
+# overload dimension (ISSUE 5 tentpole item 5): both backends built
+# with a small admission window, then driven past it — asserts bounded
+# latency, zero lost/duplicated acked commands, and that the admission
+# counters actually fired. One fast seed rides tier-1 per backend; the
+# 3-seed matrix is slow-marked.
+
+
+def test_kv_harness_overload_batch():
+    res = kv_harness.run(seed=51, n_ops=30, backend="tpu_batch",
+                         partitions=False, membership=False, overload=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("overload_acked", 0) > 0
+
+
+def test_kv_harness_overload_actor():
+    res = kv_harness.run(seed=52, n_ops=30, backend="per_group_actor",
+                         partitions=False, membership=False, overload=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("overload_acked", 0) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["tpu_batch", "per_group_actor"])
+@pytest.mark.parametrize("seed", [53, 54, 55])
+def test_kv_harness_overload_matrix(backend, seed):
+    # the acceptance matrix: overload green on both backends, >= 3 seeds,
+    # with the full nemesis mix running before the overload phase
+    res = kv_harness.run(seed=seed, n_ops=60, backend=backend, overload=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("overload_acked", 0) > 0

@@ -851,3 +851,23 @@ def test_flush_reads_exact_table_despite_concurrent_overwrite(tmp_path):
     assert mt.num_tables() == 1
     segs.close()
     sw.close()
+
+
+def test_wal_counters_writes_vs_entries(tmp_path):
+    """Counter semantics (ADVICE r5 item 4): 'writes'/'batch_size'
+    count QUEUE ITEMS — including truncate markers — while the new
+    'entries' counter counts the expanded log entries actually framed
+    (a run of k payloads is ONE write but k entries)."""
+    sink = Sink()
+    wal = mk_wal(tmp_path, sink, TableRegistry())
+    wal.write("u1", 1, 1, pickle.dumps(1))          # 1 item, 1 entry
+    wal.write_run("u1", 2, [1] * 5,
+                  [pickle.dumps(i) for i in range(5)])  # 1 item, 5 entries
+    wal.truncate_write("u1", 4)                     # 1 item, 0 entries
+    wal.write("u1", 4, 2, pickle.dumps(9))          # 1 item, 1 entry
+    wal.flush()
+    c = wal.counter.to_dict()
+    assert c["writes"] == 4
+    assert c["entries"] == 7
+    assert c["batch_size"] <= 4  # last batch, in queue items
+    assert c["batches"] >= 1
