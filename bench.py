@@ -207,41 +207,67 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             pass
         import numpy as np
 
+        from ra_tpu import obs
+
+        # latency distributions live in log-bucketed histograms
+        # (ra_tpu.obs, ~3.1% bucket error) instead of ad-hoc sample
+        # lists; the JSON percentiles below read straight off them
+        h_unloaded = obs.histogram(
+            ("bench", "unloaded_commit"),
+            help="unloaded commit latency: delivery -> leader apply")
+        h_loaded = obs.histogram(
+            ("bench", "loaded_admitted"),
+            help="loaded latency under client admission")
+        h_unbounded = obs.histogram(
+            ("bench", "loaded_unbounded"),
+            help="pre-queued (unbounded pipeline) delivery -> apply")
+        for _h in (h_unloaded, h_loaded, h_unbounded):
+            _h.reset()  # bench may rerun in-process (obs_smoke)
+
         base = coords[0]._applied_np[:groups].copy()
         names = [f"g{g}" for g in range(groups)]
         # fixed sample of groups for the commit-latency distribution
         sample = np.arange(0, groups, max(1, groups // 256), dtype=np.int64)
 
-        def run_wave(n_waves: int, loaded_lats: list = None) -> None:
+        def run_wave(n_waves: int, loaded_hist=None) -> None:
             """Pre-queue ``n_waves`` full-fleet waves (the UNBOUNDED
             deep-pipelined shape — delivery->apply latency is dominated
             by queueing, recorded as unbounded_loaded_*)."""
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             wave_t: list = []
             base0 = base[sample].copy()
-            for _ in range(n_waves):
+            for w in range(n_waves):
                 base.__iadd__(1)
                 wave_t.append(time.perf_counter())
-                coords[0].deliver_commands(names, cmd)
+                # submit stamp on the FIRST wave only: commit-stage
+                # sampling (obs.COMMIT_STAGES) wants a stamped command
+                # under deep-pipeline load, but a distinct object per
+                # wave would defeat the one-pickle-per-batch memo in
+                # Log._bulk_insert when waves coalesce into one drain
+                # (measured: 6x the encode_cmd calls, -45% throughput)
+                coords[0].deliver_commands(
+                    names,
+                    cmd._replace(ts=time.monotonic_ns()) if w == 0 else cmd,
+                )
             # per-sample pointer into wave_t: how many waves this sampled
             # group has fully applied (loaded-latency bookkeeping)
             done_w = np.zeros(len(sample), np.int64)
             while time.time() < deadline:
                 step_all()
-                if loaded_lats is not None:
+                if loaded_hist is not None:
                     now = time.perf_counter()
                     newly = np.minimum(
                         coords[0]._applied_np[sample] - base0, n_waves
                     )
                     for s in np.flatnonzero(newly > done_w):
                         for k in range(done_w[s], newly[s]):
-                            loaded_lats.append(now - wave_t[k])
+                            loaded_hist.record_seconds(now - wave_t[k])
                         done_w[s] = newly[s]
                 if all((c._applied_np[:groups] >= base).all() for c in coords):
                     return
             raise TimeoutError("wave did not complete")
 
-        def run_wave_admitted(n_waves: int, window: int, lats: list) -> None:
+        def run_wave_admitted(n_waves: int, window: int, hist) -> None:
             """Admission-paced load: the fleet's n_waves x groups
             commands are delivered as group SLICES (groups/16 lanes at a
             time), with at most ``window`` slices in flight past the
@@ -258,6 +284,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             start = base.copy()
             slice_w = max(1, groups // 16)
+            n_sampled_cache: dict = {}
             slices = [
                 np.arange(lo, min(lo + slice_w, groups))
                 for lo in range(0, groups, slice_w)
@@ -278,7 +305,9 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                     pending.append(
                         (si, time.perf_counter(), int(deliv[slices[si][0]]))
                     )
-                    coords[0].deliver_commands(slice_names[si], cmd)
+                    coords[0].deliver_commands(
+                        slice_names[si], cmd._replace(ts=time.monotonic_ns())
+                    )
                 step_all()
                 while pending:
                     si, t0w, tgt = pending[0]
@@ -288,9 +317,13 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                     ).all():
                         break
                     now = time.perf_counter()
-                    lats.extend(
-                        now - t0w for g in sl if int(g) in in_sample
-                    )
+                    n_s = n_sampled_cache.get(si)
+                    if n_s is None:
+                        n_s = n_sampled_cache[si] = sum(
+                            1 for g in sl if int(g) in in_sample
+                        )
+                    if n_s:
+                        hist.record_seconds(now - t0w, count=n_s)
                     pending.popleft()
                 if qi >= len(queue) and not pending:
                     if all(
@@ -324,7 +357,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         prev_switch_interval = sys.getswitchinterval()
         sys.setswitchinterval(0.0002)
 
-        def latency_phase(n_waves: int) -> list:
+        def latency_phase(n_waves: int):
             """p50/p99 commit latency: the sampled groups (256 of them)
             each issue ONE command while the other ~10k groups sit idle;
             latency = delivery -> leader apply per sampled group. This
@@ -335,14 +368,15 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             measuring it after them would time the segment writers
             digesting the passes' backlog, not commit latency. The
             passes report their own LOADED latency distribution."""
-            lats: list = []
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             sample_names = [f"g{g}" for g in sample]
             for _ in range(n_waves):
                 base[sample] += 1
                 done = np.zeros(len(sample), bool)
                 t0 = time.perf_counter()
-                coords[0].deliver_commands(sample_names, cmd)
+                coords[0].deliver_commands(
+                    sample_names, cmd._replace(ts=time.monotonic_ns())
+                )
                 while time.time() < deadline:
                     if not step_all():
                         # idle: the round trip is waiting on a WAL
@@ -351,13 +385,12 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                     now = time.perf_counter()
                     newly = ~done & (coords[0]._applied_np[sample] >= base[sample])
                     if newly.any():
-                        lats.extend([now - t0] * int(newly.sum()))
+                        h_unloaded.record_seconds(now - t0, count=int(newly.sum()))
                         done |= newly
                     if all((c._applied_np[:groups] >= base).all() for c in coords):
                         break
                 else:
                     raise TimeoutError("latency wave did not complete")
-            return lats
 
         try:
             run_wave(1)  # warmup: compiles remaining scatter/step shapes
@@ -369,13 +402,17 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # unloaded commit latency FIRST (quiesced storage, idle fleet)
         if wal:
             drain_storage()
+        # discard the warmup latency_phase(1) samples (compile/cold-path
+        # time); the throughput warmup run_wave(1) records nothing here
+        h_unloaded.reset()
         try:
-            lats = latency_phase(8)
+            latency_phase(8)
         except TimeoutError:
             print("bench error: latency phase incomplete", file=sys.stderr)
             _retry_on_cpu_or_fail()
-        p50 = float(np.percentile(lats, 50) * 1000)
-        p99 = float(np.percentile(lats, 99) * 1000)
+        p50, p90, p99, p999 = (
+            v / 1e6 for v in h_unloaded.percentiles((50, 90, 99, 99.9))
+        )
 
         # best-of-3 measured passes: the rate measures framework
         # capability, and a single pass on a shared 1-core host is at
@@ -398,7 +435,6 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         ADMIT_WINDOW = 1
         total = groups * cmds
         best = 0.0
-        unbounded: list = []
         for _pass in range(3):
             # per-group baselines: the latency warmup advances only the
             # sampled groups, so states are not uniform across groups
@@ -407,7 +443,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             ]
             t0 = time.perf_counter()
             try:
-                run_wave(cmds, loaded_lats=unbounded)
+                run_wave(cmds, loaded_hist=h_unbounded)
             except TimeoutError:
                 if best > 0:
                     # a fully verified earlier pass already produced a
@@ -441,7 +477,6 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # floor, so delivery->apply measures commit latency UNDER load
         # instead of time-in-queue. Its rate is reported too — the
         # throughput cost of bounding latency is part of the story.
-        loaded: list = []
         admitted_rate = None
         deadline = time.time() + 600  # fresh budget for this phase
         # steady-state latency needs rounds, not the full 96-wave
@@ -450,7 +485,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         adm_waves = max(1, min(cmds, 24))
         t0 = time.perf_counter()
         try:
-            run_wave_admitted(adm_waves, ADMIT_WINDOW, loaded)
+            run_wave_admitted(adm_waves, ADMIT_WINDOW, h_loaded)
             admitted_rate = round(
                 groups * adm_waves / (time.perf_counter() - t0), 1)
         except TimeoutError:
@@ -471,25 +506,43 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
+            "latency_source": (
+                "log-bucketed histograms (ra_tpu.obs.LogHistogram, "
+                "power-of-two buckets x 32 linear sub-buckets, <=3.1% "
+                "quantile error)"
+            ),
             "p50_ms": round(p50, 2),
+            "p90_ms": round(p90, 2),
             "p99_ms": round(p99, 2),
+            "p99_9_ms": round(p999, 2),
             "admission_inflight_slices": ADMIT_WINDOW,
             "admitted_cmds_per_sec": admitted_rate,
             "loaded_p50_ms": (
-                round(float(np.percentile(loaded, 50) * 1000), 2)
-                if loaded else None
+                round(h_loaded.percentile(50) / 1e6, 2) if h_loaded.n else None
+            ),
+            "loaded_p90_ms": (
+                round(h_loaded.percentile(90) / 1e6, 2) if h_loaded.n else None
             ),
             "loaded_p99_ms": (
-                round(float(np.percentile(loaded, 99) * 1000), 2)
-                if loaded else None
+                round(h_loaded.percentile(99) / 1e6, 2) if h_loaded.n else None
+            ),
+            "loaded_p99_9_ms": (
+                round(h_loaded.percentile(99.9) / 1e6, 2)
+                if h_loaded.n else None
             ),
             "unbounded_loaded_p50_ms": (
-                round(float(np.percentile(unbounded, 50) * 1000), 2)
-                if unbounded else None
+                round(h_unbounded.percentile(50) / 1e6, 2)
+                if h_unbounded.n else None
             ),
             "unbounded_loaded_p99_ms": (
-                round(float(np.percentile(unbounded, 99) * 1000), 2)
-                if unbounded else None
+                round(h_unbounded.percentile(99) / 1e6, 2)
+                if h_unbounded.n else None
+            ),
+            "secondary_artifacts": (
+                "record BENCH_NOWAL (--no-wal), BENCH_DECISIONS_* "
+                "(--decisions, CPU + TPU) and one threaded-loop run "
+                "alongside every perf round (ROADMAP item 5) so the "
+                "trajectory stays trackable"
             ),
         }
     finally:

@@ -1,40 +1,165 @@
-"""Profile one WAL-backed pipelined run (dev tool, not shipped API).
+"""Phase-attribution profiler for the WAL-backed pipelined bench.
 
-Usage: PYTHONPATH= JAX_PLATFORMS=cpu python profile_wave.py [groups] [cmds]
+Runs ``bench_pipeline`` with the obs instrumentation live and emits the
+wave-phase cost attribution as MARKDOWN tables — the top-5 cost table
+ROADMAP item 2 asks for (published in docs/INTERNALS.md §13) — plus the
+commit-latency stage decomposition and the WAL flush/fsync
+distributions. ``--cprofile`` additionally wraps the run in cProfile
+and dumps cumulative stats (the old behavior).
+
+The step-loop phases (ingress_drain, host_pack, device_step,
+host_egress, aer_fanout) are disjoint slices of every coordinator
+step — their share column attributes the whole step loop. apply and
+wal_handoff are SUBSETS of host_egress / ingress_drain respectively,
+and the WAL rows run on their own threads (concurrent with the loop);
+they are listed for attribution, not added to the share denominator.
+
+Usage: PYTHONPATH= JAX_PLATFORMS=cpu python profile_wave.py
+       [groups] [cmds] [--top N] [--cprofile]
 """
-import cProfile
-import io
-import pstats
+import argparse
 import sys
 import time
 
 # capture our CLI args BEFORE truncating (bench's argparse must not see
-# them) — truncating first silently dropped the documented [groups]
-# [cmds] arguments
+# them) — truncating first silently dropped the documented arguments
 _ARGS = sys.argv[1:]
 sys.argv = [sys.argv[0]]
 
+# the disjoint/subset split lives next to the phase definitions in
+# ra_tpu.obs (WAVE_STEP_PHASES / WAVE_SUBSET_PHASES) so a new phase
+# shows up here without touching this tool; resolved lazily because
+# importing ra_tpu pulls in jax and argv handling must run first
+def _phase_split():
+    from ra_tpu import obs
 
-def main(groups=2048, cmds=24):
+    return (
+        tuple(ph for ph, _ in obs.WAVE_STEP_PHASES),
+        dict(obs.WAVE_SUBSET_PHASES),
+    )
+
+
+def _merged(names):
+    """Merge the histograms under ``names`` into one (None if absent)."""
+    from ra_tpu import obs
+
+    out = None
+    for name in names:
+        h = obs.histograms().fetch(name)
+        if h is None or h.n == 0:
+            continue
+        if out is None:
+            out = obs.LogHistogram(name)
+        out.merge(h)
+    return out
+
+
+def _fmt_ms(ns: float) -> str:
+    return f"{ns / 1e6:.3f}"
+
+
+def phase_tables(nodes, top: int = 5) -> str:
+    """Markdown cost tables from the live obs registry (call after a
+    bench/workload ran in this process)."""
+    from ra_tpu import obs
+
+    step_phases, subset_phases = _phase_split()
+    rows = []
+    for ph in step_phases + tuple(subset_phases):
+        h = _merged([("wave", n, ph) for n in nodes])
+        if h is not None:
+            rows.append((ph, h))
+    denom = sum(h.total for ph, h in rows if ph in step_phases) or 1
+    rows.sort(key=lambda r: r[1].total, reverse=True)
+    out = [f"| rank | phase | total s | share of step loop | samples "
+           f"| p50 ms | p99 ms | note |",
+           "|---|---|---|---|---|---|---|---|"]
+    for i, (ph, h) in enumerate(rows[:top], 1):
+        p50, p99 = h.percentiles((50, 99))
+        note = subset_phases.get(ph, "")
+        share = (
+            f"{100.0 * h.total / denom:.1f}%" if ph in step_phases else "—"
+        )
+        out.append(
+            f"| {i} | {ph} | {h.total / 1e9:.2f} | {share} | {h.n} "
+            f"| {_fmt_ms(p50)} | {_fmt_ms(p99)} | {note} |"
+        )
+    tables = ["### Wave-phase cost attribution (top "
+              f"{min(top, len(rows))})", ""] + out
+
+    crows = []
+    for st, _help in obs.COMMIT_STAGES:
+        h = _merged([("commit", n, st) for n in nodes])
+        if h is not None:
+            crows.append((st, h))
+    if crows:
+        tables += ["", "### Commit-latency stage decomposition", "",
+                   "| stage | samples | p50 ms | p90 ms | p99 ms | mean ms |",
+                   "|---|---|---|---|---|---|"]
+        for st, h in crows:
+            p50, p90, p99 = h.percentiles((50, 90, 99))
+            tables.append(
+                f"| {st} | {h.n} | {_fmt_ms(p50)} | {_fmt_ms(p90)} "
+                f"| {_fmt_ms(p99)} | {h.mean() / 1e6:.3f} |"
+            )
+
+    wrows = [
+        (name, obs.histograms().fetch(name))
+        for name in obs.histograms().names()
+        if isinstance(name, tuple) and name and name[0] == "wal"
+    ]
+    wrows = [(n, h) for n, h in wrows if h is not None and h.n]
+    if wrows:
+        tables += ["", "### WAL (own threads, concurrent with the loop)",
+                   "", "| histogram | samples | total s | p50 ms | p99 ms |",
+                   "|---|---|---|---|---|"]
+        for name, h in sorted(wrows, key=lambda r: -r[1].total):
+            p50, p99 = h.percentiles((50, 99))
+            tables.append(
+                f"| {name[1]}/{name[2]} | {h.n} | {h.total / 1e9:.2f} "
+                f"| {_fmt_ms(p50)} | {_fmt_ms(p99)} |"
+            )
+    return "\n".join(tables)
+
+
+def main(groups=2048, cmds=24, top=5, cprofile=False) -> None:
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from bench import bench_pipeline
 
     t0 = time.perf_counter()
-    pr = cProfile.Profile()
-    pr.enable()
+    pr = None
+    if cprofile:
+        import cProfile
+
+        pr = cProfile.Profile()
+        pr.enable()
     out = bench_pipeline(groups, cmds, wal=True)
-    pr.disable()
+    if pr is not None:
+        pr.disable()
     dt = time.perf_counter() - t0
-    print(f"\ntotal wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
+    print(f"total wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
           f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms", file=sys.stderr)
-    s = io.StringIO()
-    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
-    ps.print_stats(45)
-    print(s.getvalue(), file=sys.stderr)
+    print(f"\n## profile_wave: {groups} groups x {cmds} cmds "
+          f"(WAL-backed, {out['value']:.0f} cmd/s, unloaded "
+          f"p50 {out['p50_ms']} ms)\n")
+    print(phase_tables([f"bench{i}" for i in range(3)], top=top))
+    if pr is not None:
+        import io
+        import pstats
+
+        s = io.StringIO()
+        ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+        ps.print_stats(45)
+        print(s.getvalue(), file=sys.stderr)
 
 
 if __name__ == "__main__":
-    g = int(_ARGS[0]) if len(_ARGS) > 0 else 2048
-    c = int(_ARGS[1]) if len(_ARGS) > 1 else 24
-    main(g, c)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("groups", type=int, nargs="?", default=2048)
+    ap.add_argument("cmds", type=int, nargs="?", default=24)
+    ap.add_argument("--top", type=int, default=5)
+    ap.add_argument("--cprofile", action="store_true",
+                    help="also run under cProfile (the old default)")
+    args = ap.parse_args(_ARGS)
+    main(args.groups, args.cmds, top=args.top, cprofile=args.cprofile)

@@ -239,7 +239,8 @@ def process_command(
     backoff = 0.01
     while time.monotonic() < deadline:
         fut = Future()
-        cmd = Command(kind=USR, data=data, reply_mode="await_consensus", from_ref=fut)
+        cmd = Command(kind=USR, data=data, reply_mode="await_consensus",
+                      from_ref=fut, ts=time.monotonic_ns())
         if not _try_send(target, cmd):
             target = _next_target(server_id, target, tried)
             continue
@@ -387,7 +388,7 @@ def pipeline_command(
     resend by correlation, exactly as with a lost message (the
     reference gives pipeline_command the same non-guarantee)."""
     cmd = Command(kind=USR, data=data, reply_mode=("notify", correlation, who),
-                  priority=priority)
+                  priority=priority, ts=time.monotonic_ns())
     return _try_send(server_id, cmd)
 
 
@@ -608,3 +609,64 @@ def counters_overview() -> dict:
     from ra_tpu import counters as _counters
 
     return _counters.overview()
+
+
+def cluster_commit_rates() -> Dict[str, dict]:
+    """Per-cluster leader + members + smoothed commit rate, joined from
+    the leaderboard and the li-driven ``commit_rate`` gauges (per-server
+    counters on the actor backend; the coordinator-aggregate gauge on
+    the batch backend, reported with ``"scope": "node"``). The single
+    data source for placement / leader balancing (ROADMAP item 1)."""
+    from ra_tpu import counters as _counters
+
+    out: Dict[str, dict] = {}
+    for cluster, (leader, members) in leaderboard.snapshot().items():
+        rate: Optional[int] = None
+        scope = None
+        if leader is not None:
+            c = _counters.fetch((cluster, leader))
+            if c is not None:
+                rate = c.get("commit_rate")
+                scope = "server"
+            else:
+                cc = _counters.fetch(("coordinator", leader[1]))
+                if cc is not None:
+                    # batch-backed leader: groups share one coordinator-
+                    # aggregate gauge (no per-group counter vectors)
+                    rate = cc.get("commit_rate")
+                    scope = "node"
+        out[cluster] = {
+            "leader": leader,
+            "members": list(members),
+            "commit_rate": rate,
+            "commit_rate_scope": scope,
+        }
+    return out
+
+
+def system_overview(node_name: str, last_events: int = 100) -> dict:
+    """One-call observability surface for a node (parity with the
+    reference's ``ra:overview/1``, extended with the histogram/trace
+    machinery of docs/INTERNALS.md §13): the node overview, every
+    registered counter vector WITH field kind/help, latency-histogram
+    percentiles (wave phases, commit stages, WAL), per-cluster commit
+    rates, and the most recent flight-recorder events."""
+    from ra_tpu import counters as _counters
+    from ra_tpu import obs as _obs
+
+    return {
+        "node": node_name,
+        "overview": _mgmt_route(node_name).overview(),
+        "counters": _counters.registry().describe_overview(),
+        "histograms": _obs.histograms().overview(),
+        "clusters": cluster_commit_rates(),
+        "events": _obs.flight_recorder().events(last=last_events),
+    }
+
+
+def prometheus_metrics() -> str:
+    """Prometheus text exposition of every counter and histogram
+    (scrape surface; see scripts/obs_smoke.sh for the CI check)."""
+    from ra_tpu import obs as _obs
+
+    return _obs.prometheus_text()

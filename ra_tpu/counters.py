@@ -111,6 +111,10 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
     ("stale_peer_resends", "counter",
      "pipeline-window stalls against a silent peer resolved by an "
      "empty probe AER (its ack/reject hint resynchronizes match/next)"),
+    ("commit_rate", "gauge",
+     "aggregate applied-entries/sec across this coordinator's groups "
+     "(leaky-integrator smoothed, sampled per tick — the batch-backend "
+     "feed for placement/leader-balancing decisions)"),
 ]
 
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [
@@ -145,6 +149,15 @@ class Counters:
     def to_dict(self) -> Dict[str, int]:
         return {f[0]: int(self.arr[i]) for i, f in enumerate(self.fields)}
 
+    def describe(self) -> List[Dict[str, object]]:
+        """Field metadata + current values: [{name, kind, help, value}]
+        — the exposition shape (``overview()`` drops kind/help; scrape
+        surfaces need them for TYPE/HELP lines)."""
+        return [
+            {"name": f[0], "kind": f[1], "help": f[2], "value": int(self.arr[i])}
+            for i, f in enumerate(self.fields)
+        ]
+
 
 class CounterRegistry:
     """Process-global registry: name -> Counters."""
@@ -168,7 +181,12 @@ class CounterRegistry:
             return c
 
     def fetch(self, name) -> Optional[Counters]:
-        return self._tab.get(name)
+        # take the lock like new()/delete(): a bare dict read can race a
+        # concurrent resize (delete+new) and CPython only guarantees
+        # atomicity for builtin-key gets — registry keys are tuples of
+        # arbitrary objects
+        with self._lock:
+            return self._tab.get(name)
 
     def delete(self, name) -> None:
         with self._lock:
@@ -176,6 +194,13 @@ class CounterRegistry:
 
     def overview(self) -> Dict[object, Dict[str, int]]:
         return {k: v.to_dict() for k, v in list(self._tab.items())}
+
+    def describe_overview(self) -> Dict[object, List[Dict[str, object]]]:
+        """Exposition overview: every registered vector with field kind
+        and help text alongside the values (what ``overview()`` drops)."""
+        with self._lock:
+            items = list(self._tab.items())
+        return {k: v.describe() for k, v in items}
 
     def names(self) -> List[object]:
         return list(self._tab.keys())

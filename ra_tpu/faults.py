@@ -171,6 +171,15 @@ def _take(fp: _Failpoint, scope: Optional[str]) -> Optional[Tuple]:
         with _lock:
             if _armed.get(fp.site) is fp:
                 del _armed[fp.site]
+    # a firing failpoint is a flight-recorder event: post-mortem traces
+    # of nemesis runs must show WHEN each injected fault bit relative
+    # to the role changes/depositions around it
+    from ra_tpu import obs as _obs
+
+    _obs.record_event(
+        "failpoint", node=scope,
+        detail=f"{fp.site} -> {fp.action!r} (fire #{fp.fire_count})",
+    )
     return fp.action
 
 

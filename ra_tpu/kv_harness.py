@@ -519,10 +519,21 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             except Exception:  # noqa: BLE001
                 pass
         leaderboard.clear()
+    _dump_on_failure(model.failures, f"actor seed={seed}")
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
         ops=counts, final_model=dict(model.sure),
     )
+
+
+def _dump_on_failure(failures, label: str) -> None:
+    """Consistency/liveness failure -> dump the flight recorder: the
+    post-mortem event trace (elections, depositions, failpoint fires,
+    watchdog strikes) is what makes a nemesis flake debuggable."""
+    if failures:
+        from ra_tpu import obs
+
+        obs.flight_recorder().dump(header=f" [kv_harness {label}]")
 
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
@@ -810,6 +821,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
             shutil.rmtree(base, ignore_errors=True)
         leaderboard.clear()
+    _dump_on_failure(model.failures, f"batch seed={seed}")
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
         ops=counts, final_model=dict(model.sure),

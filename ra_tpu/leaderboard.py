@@ -32,6 +32,14 @@ def lookup_members(cluster_name: str) -> Tuple[ServerId, ...]:
     return got[1] if got else ()
 
 
+def snapshot() -> Dict[str, Tuple[Optional[ServerId], Tuple[ServerId, ...]]]:
+    """Point-in-time copy of the whole table (cluster -> (leader,
+    members)) — the single data source ``api.system_overview`` joins
+    commit-rate gauges against."""
+    with _lock:
+        return dict(_tab)
+
+
 def clear(cluster_name: Optional[str] = None) -> None:
     with _lock:
         if cluster_name is None:

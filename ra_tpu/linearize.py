@@ -168,6 +168,13 @@ def check_history(
                 for o in sorted(ops, key=lambda o: o.inv)[:12]
             )
             violations.append(f"key {key!r} not linearizable: {detail}")
+    if violations:
+        # a nemesis-tier linearizability failure dumps the flight
+        # recorder: the election/deposition/failpoint trace around the
+        # violating window is the first thing a debugger needs
+        from ra_tpu import obs
+
+        obs.flight_recorder().dump(header=" [linearize]")
     return CheckResult(
         ok=not violations,
         violations=violations,

@@ -31,6 +31,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import zlib
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -110,6 +111,25 @@ class Wal:
             native = _native.available()
         self._native = native
         self.counter = counter or ra_counters.Counters("wal", ra_counters.WAL_FIELDS)
+        # fsync-wait and batch-flush histograms (docs/INTERNALS.md §13);
+        # keyed by the WAL directory's basename so every WAL in a
+        # multi-node process exports its own distribution
+        from ra_tpu import obs as _obs
+
+        _norm = os.path.normpath(dir)
+        _parent = os.path.basename(os.path.dirname(_norm))
+        _scope = (
+            f"{_parent}/{os.path.basename(_norm)}" if _parent
+            else (os.path.basename(_norm) or "wal")
+        )
+        self._h_fsync = _obs.histogram(
+            ("wal", _scope, "fsync"), help="WAL fsync/fdatasync wait"
+        )
+        self._h_batch = _obs.histogram(
+            ("wal", _scope, "batch"),
+            help="WAL batch flush (frame + write + fsync + notify)",
+        )
+        self._obs_rec = _obs.flight_recorder()
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -202,7 +222,9 @@ class Wal:
                 batch = self._take_batch_locked()
             if not batch:
                 return
+            t0 = time.perf_counter_ns()
             self._write_batch(batch)
+            self._h_batch.record(time.perf_counter_ns() - t0)
 
     def close(self) -> None:
         with self._cv:
@@ -237,7 +259,9 @@ class Wal:
                 batch = self._take_batch_locked()
             if batch:
                 try:
+                    t0 = time.perf_counter_ns()
                     self._write_batch(batch)
+                    self._h_batch.record(time.perf_counter_ns() - t0)
                 except Exception as exc:  # noqa: BLE001
                     # any unexpected error is a failure episode, same as
                     # a file I/O error: the batch is unacked (servers
@@ -464,11 +488,19 @@ class Wal:
         faults.fire("wal.fsync", self.fault_scope)
         self._file.flush()
         if self.sync_method == "datasync":
+            t0 = time.perf_counter_ns()
             os.fdatasync(self._file.fileno())
+            dt = time.perf_counter_ns() - t0
             self.counter.incr("fsyncs")
+            self.counter.incr("fsync_time_us", dt // 1000)
+            self._h_fsync.record(dt)
         elif self.sync_method == "sync":
+            t0 = time.perf_counter_ns()
             os.fsync(self._file.fileno())
+            dt = time.perf_counter_ns() - t0
             self.counter.incr("fsyncs")
+            self.counter.incr("fsync_time_us", dt // 1000)
+            self._h_fsync.record(dt)
 
     def _uid_ref(self, uid: str, records: List[Tuple]) -> int:
         ref = self._uid_refs.get(uid)
@@ -578,6 +610,10 @@ class Wal:
                 return  # one failure episode -> one on_failure callback
             self._failed = True
         self.counter.incr("failures")
+        self._obs_rec.record(
+            "wal_failure", node=self.fault_scope,
+            detail=f"{type(exc).__name__}: {exc}",
+        )
         cb = self.on_failure
         if cb is not None:
             try:

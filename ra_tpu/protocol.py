@@ -58,23 +58,32 @@ class Command(NamedTuple):
     # admission window must never shed them (client commands are
     # rejected/dropped instead — they have a caller or owe no ack)
     internal: bool = False
+    # optional submit timestamp (time.monotonic_ns at client submit).
+    # Commands carrying one are eligible for commit-latency stage
+    # sampling (obs.COMMIT_STAGES); None opts out — internal commands
+    # and bare constructions never pay the sampling cost
+    ts: Any = None
 
 
 # -- snapshot metadata -----------------------------------------------------
 
 
 def strip_entry_refs(entries: "Tuple[Entry, ...]") -> "Tuple[Entry, ...]":
-    """Drop process-ephemeral reply handles from entries about to cross a
-    process boundary (replication / snapshot pre-chunks). The leader
-    keeps the handles in its pending-reply table; remote copies never
-    need them."""
+    """Drop process-ephemeral fields from entries about to cross a
+    process boundary (replication / snapshot pre-chunks): reply handles
+    (the leader keeps them in its pending-reply table; remote copies
+    never need them) and the volatile submit timestamp (``ts`` is a
+    LOCAL monotonic stamp — another machine's clock base makes it
+    meaningless, and latency sampling must never compare across)."""
     out = []
     changed = False
     for e in entries:
         cmd = e.cmd
-        if isinstance(cmd, Command) and cmd.from_ref is not None:
+        if isinstance(cmd, Command) and (
+            cmd.from_ref is not None or cmd.ts is not None
+        ):
             out.append(
-                Entry(e.index, e.term, cmd._replace(from_ref=None))
+                Entry(e.index, e.term, cmd._replace(from_ref=None, ts=None))
             )
             changed = True
         else:
@@ -84,6 +93,12 @@ def strip_entry_refs(entries: "Tuple[Entry, ...]") -> "Tuple[Entry, ...]":
 
 def sanitize_for_wire(msg: Any) -> Any:
     """Make a protocol message safe to serialize across processes."""
+    if isinstance(msg, Command) and msg.ts is not None:
+        # the submit stamp is time.monotonic_ns() on the SENDING
+        # machine; a remote leader comparing it against its own clock
+        # base would record garbage submit_append samples — remote
+        # commands simply opt out of commit-stage sampling
+        return msg._replace(ts=None)
     if isinstance(msg, AppendEntriesRpc) and msg.entries:
         stripped = strip_entry_refs(msg.entries)
         if stripped is not msg.entries:
@@ -101,11 +116,16 @@ def encode_cmd(cmd: Any) -> bytes:
     """Serialize a log command for durable storage. Client reply handles
     (``from_ref``) are process-ephemeral — replies are never re-issued
     after a restart (same rule as the reference, INTERNALS.md:91-106) —
-    so they are stripped before pickling."""
+    so they are stripped before pickling, as is the volatile submit
+    timestamp (``ts``): a monotonic stamp is meaningless across a
+    restart, and stripping keeps identical payloads byte-identical on
+    disk regardless of when they were submitted."""
     import pickle
 
-    if isinstance(cmd, Command) and cmd.from_ref is not None:
-        cmd = cmd._replace(from_ref=None)
+    if isinstance(cmd, Command) and (
+        cmd.from_ref is not None or cmd.ts is not None
+    ):
+        cmd = cmd._replace(from_ref=None, ts=None)
     return pickle.dumps(cmd)
 
 

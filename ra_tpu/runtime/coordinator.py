@@ -113,7 +113,7 @@ class GroupHost:
         "pending_queries", "machine_timers", "has_tick", "snap_floor",
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
-        "specials", "last_ok_sent", "fresh_tail", "match_hint",
+        "specials", "last_ok_sent", "fresh_tail", "match_hint", "lat",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
@@ -217,6 +217,11 @@ class GroupHost:
         # match_index in its Next - Match <= ?MAX_PIPELINE_COUNT gate,
         # src/ra_server.erl:2308-2329)
         self.match_hint: List[int] = [0] * len(self.members)
+        # in-flight commit-latency sample (obs.COMMIT_STAGES): at most
+        # one per group, [idx, t_submit, t_append, t_durable, t_commit]
+        # in monotonic ns. Only sampled groups (gid & lat_mask == 0)
+        # for commands carrying a submit ts ever allocate one.
+        self.lat: Optional[list] = None
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -271,10 +276,28 @@ class BatchCoordinator:
         self.max_command_backlog = max_command_backlog
         self.command_deadline_s = command_deadline_s
         from ra_tpu import counters as _counters
+        from ra_tpu import obs as _obs
+        from ra_tpu.li import LeakyIntegrator
 
         self.counters = _counters.new(
             ("coordinator", node_name), _counters.COORDINATOR_FIELDS
         )
+        # wave-phase + commit-stage histograms (docs/INTERNALS.md §13)
+        # and the flight recorder; per-node histogram names so batch-
+        # and actor-backed members on one node share a commit family
+        self._wave_h = _obs.wave_hists(node_name)
+        self._commit_h = _obs.commit_hists(node_name)
+        self._obs_rec = _obs.flight_recorder()
+        # commit-latency sampling mask: groups with gid & mask == 0 are
+        # eligible (bounds hot-path cost to ~1/64 of groups); _lat_gids
+        # tracks the gids with a sample in flight so per-step sweeps
+        # (the durable-watermark check) cost nothing when none is
+        self._lat_mask = 63
+        self._lat_gids: set = set()
+        # aggregate commit-rate gauge over all groups (the batch-backend
+        # analog of the per-proc ra_li integrator), sampled per tick
+        self._commit_li = LeakyIntegrator()
+        self._commit_li_prev: Optional[Tuple[float, int]] = None
         # activity-scaled stepping: "auto" runs the fused step over a
         # compact gather of just the groups with pending device work
         # whenever they number at most capacity/4 (power-of-two padded
@@ -639,6 +662,7 @@ class BatchCoordinator:
             return self._step_once_locked()
 
     def _step_once_locked(self) -> bool:
+        _t_in = time.perf_counter_ns()
         with self._ingress_cv:
             batch = list(self._ingress)
             self._ingress.clear()
@@ -686,6 +710,8 @@ class BatchCoordinator:
             or self._pending_scatters or self._pending_roles
         ):
             return False
+        _t_drain = time.perf_counter_ns()
+        _t_pack = _t_dev = None
 
         if self._pending_roles:
             gids, roles, _ = self._pad3(
@@ -724,6 +750,17 @@ class BatchCoordinator:
             gids, los, his, terms = self._pad4(rows)
             self.state = C.record_appended_runs(self.state, gids, los, his, terms)
         if written:
+            if self._lat_gids:
+                now_w = time.monotonic_ns()
+                for gid_w in self._lat_gids:
+                    idx_w = written.get(gid_w)
+                    gw = self.groups[gid_w] if idx_w is not None else None
+                    if gw is None:
+                        continue
+                    lat = gw.lat
+                    if lat is not None and lat[3] == 0 and idx_w >= lat[0]:
+                        lat[3] = now_w
+                        self._commit_h["append_durable"].record(now_w - lat[2])
             gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written.items()])
             self.state = C.record_written(self.state, gids, idxs)
 
@@ -740,10 +777,12 @@ class BatchCoordinator:
         if act is not None:
             if act:
                 packed, gidx, act_np, consumed = self._build_mailbox_sub(act)
+                _t_pack = time.perf_counter_ns()
                 self.state, eg_packed = C.consensus_step_packed_sub(
                     self.state, packed, gidx
                 )
                 eg_np = np.asarray(eg_packed)
+                _t_dev = time.perf_counter_ns()
                 eg = {
                     name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)
                 }
@@ -758,8 +797,10 @@ class BatchCoordinator:
                 # the mesh (no-op when the layout is already right)
                 self.state = jax.device_put(self.state, self._shard_state)
                 packed = jax.device_put(packed, self._shard_mbox)
+            _t_pack = time.perf_counter_ns()
             self.state, eg_packed = C.consensus_step_packed(self.state, packed)
             eg_np = np.asarray(eg_packed)
+            _t_dev = time.perf_counter_ns()
             # egress is host-synced: the device has fully consumed the
             # mailbox view, so the pack buffer may be reused
             self._mbox_in_flight = False
@@ -782,7 +823,20 @@ class BatchCoordinator:
                     "%s after handler crash", self.name, type(msg).__name__,
                     g.name,
                 )
+        _t_eg = time.perf_counter_ns()
         self._send_aers(aer_dirty)
+        _t_aer = time.perf_counter_ns()
+        # per-step wave-phase breakdown (obs.WAVE_PHASES). host_pack
+        # covers queued-scatter application + mailbox build; device_step
+        # is dispatch + egress host sync; host_egress includes apply and
+        # client replies (apply also gets its own per-group histogram).
+        wh = self._wave_h
+        wh["ingress_drain"].record(_t_drain - _t_in)
+        if _t_pack is not None:
+            wh["host_pack"].record(_t_pack - _t_drain)
+            wh["device_step"].record(_t_dev - _t_pack)
+            wh["host_egress"].record(_t_eg - _t_dev)
+        wh["aer_fanout"].record(_t_aer - _t_eg)
         return True
 
     def _pad(self, rows, width: int):
@@ -979,8 +1033,18 @@ class BatchCoordinator:
                 self.counters.incr(
                     "commands_dropped_overload", len(shed) - n_rej
                 )
+            if shed:
+                self._obs_rec.record(
+                    "admission_reject", node=self.name, group=g.name,
+                    term=term,
+                    detail=f"rejected={n_rej} dropped={len(shed) - n_rej}",
+                )
             if not cmds:
                 return
+        # commit-stage sampling: bounded to groups on the sample mask,
+        # and only for commands stamped with a submit ts
+        sampled = (gid & self._lat_mask) == 0
+        t_h0 = time.monotonic_ns() if sampled else 0
         # fast path: plain user commands owing no replies (the pipeline
         # shape) — build the run in one pass and bulk-append it
         simple = True
@@ -1015,6 +1079,19 @@ class BatchCoordinator:
         if idx == first:
             return  # every command was rejected
         last = idx - 1
+        if sampled:
+            now_ns = time.monotonic_ns()
+            self._wave_h["wal_handoff"].record(now_ns - t_h0)
+            ts0 = cmds[0].ts
+            lat = g.lat
+            if ts0 is not None and (
+                lat is None or now_ns - lat[1] > 10_000_000_000
+            ):
+                # one in-flight sample per group; a sample stranded >10s
+                # (leadership churn) is abandoned and replaced
+                g.lat = [last, ts0, now_ns, 0, 0]
+                self._lat_gids.add(gid)
+                self._commit_h["submit_append"].record(now_ns - ts0)
         runs = appended.get(gid)
         if runs is None:
             appended[gid] = [[first, last, term]]
@@ -1477,6 +1554,12 @@ class BatchCoordinator:
                     continue
                 new_role = role_l[p]
                 if new_role != g.role:
+                    self._obs_rec.record(
+                        "role_change", node=self.name, group=g.name,
+                        term=gterm_l[p],
+                        detail=f"{self._ROLE_NAMES.get(g.role, g.role)}->"
+                               f"{self._ROLE_NAMES.get(new_role, new_role)}",
+                    )
                     # role transitions restart the leaderless-suspicion
                     # window (a just-deposed leader must give the new
                     # one a chance to make contact before suspecting)
@@ -1690,6 +1773,22 @@ class BatchCoordinator:
         hi = min(commit_index, li)
         if hi <= g.last_applied:
             return
+        # apply-duration histogram is SAMPLED (same mask as the commit
+        # stages): at 10k groups per wave an unconditional record per
+        # group is a measurable tax on the loop it measures
+        _t_apply0 = (
+            time.perf_counter_ns() if (g.gid & self._lat_mask) == 0 else 0
+        )
+        # commit-stage sample: the tracked entry commits (and applies)
+        # in THIS call iff it is durable and within hi; ``lat`` stays a
+        # local None otherwise so the hot loop pays one check per entry
+        lat = g.lat
+        if lat is not None:
+            if lat[3] == 0 or lat[0] > hi:
+                lat = None  # not durable yet / commits in a later round
+            elif lat[4] == 0:
+                lat[4] = time.monotonic_ns()
+                self._commit_h["durable_commit"].record(lat[4] - lat[3])
         # hot loop: locals bound once, apply-result normalization inlined
         # (machines return (state, reply) or (state, reply, effects))
         entries = g.log.fetch_range(g.last_applied + 1, hi)
@@ -1726,7 +1825,23 @@ class BatchCoordinator:
                 g.machine_state = batched
                 g.last_applied = hi
                 self._applied_np[g.gid] = hi
-                self._commit_gates(g, hi, is_leader)
+                if lat is not None:
+                    # noreply pipeline shape: the reply stage is the
+                    # post-apply bookkeeping fan-out (no future owed)
+                    now2 = time.monotonic_ns()
+                    self._commit_h["commit_apply"].record(now2 - lat[4])
+                    self._commit_gates(g, hi, is_leader)
+                    self._commit_h["apply_reply"].record(
+                        time.monotonic_ns() - now2
+                    )
+                    g.lat = None
+                    self._lat_gids.discard(g.gid)
+                else:
+                    self._commit_gates(g, hi, is_leader)
+                if _t_apply0:
+                    self._wave_h["apply"].record(
+                        time.perf_counter_ns() - _t_apply0
+                    )
                 return
         mac = machine.which_module(mver)
         apply_fn = mac.apply
@@ -1746,6 +1861,19 @@ class BatchCoordinator:
                 if len(res) > 2 and res[2]:
                     g.machine_state = state  # effects may read/snapshot it
                     self._realise_effects(g, res[2], is_leader)
+                if lat is not None and entry.index == lat[0]:
+                    t_ap = time.monotonic_ns()
+                    self._commit_h["commit_apply"].record(t_ap - lat[4])
+                    if pending:
+                        fut = pending.pop(entry.index, None)
+                        if fut is not None and is_leader:
+                            self._reply(fut, ("ok", res[1], me))
+                    self._commit_h["apply_reply"].record(
+                        time.monotonic_ns() - t_ap
+                    )
+                    g.lat = lat = None
+                    self._lat_gids.discard(g.gid)
+                    continue
                 if pending:
                     fut = pending.pop(entry.index, None)
                     if fut is not None and is_leader:
@@ -1782,6 +1910,15 @@ class BatchCoordinator:
         g.machine_state = state
         g.last_applied = hi
         self._applied_np[g.gid] = hi
+        if lat is not None:
+            # tracked entry was non-USR (rare): close the sample here
+            now2 = time.monotonic_ns()
+            self._commit_h["commit_apply"].record(now2 - lat[4])
+            self._commit_h["apply_reply"].record(time.monotonic_ns() - now2)
+            g.lat = None
+            self._lat_gids.discard(g.gid)
+        if _t_apply0:
+            self._wave_h["apply"].record(time.perf_counter_ns() - _t_apply0)
 
     def _commit_gates(self, g: GroupHost, hi: int, is_leader: bool) -> None:
         """Noop-commit gate for apply paths that skip the per-entry loop
@@ -1939,6 +2076,11 @@ class BatchCoordinator:
             self._reply(pending.pop(i), (verdict, leader))
         if doomed:
             self.counters.incr(counter, len(doomed))
+            self._obs_rec.record(
+                "deposition", node=self.name, group=g.name, term=g.term,
+                detail=f"{len(doomed)} pending futures answered "
+                       f"{verdict!r} ({counter})",
+            )
 
     # -- outbound ----------------------------------------------------------
 
@@ -2131,6 +2273,10 @@ class BatchCoordinator:
                 return
             if g.voter_status.get(g.self_slot) != "voter":
                 return  # nonvoters never start elections
+            self._obs_rec.record(
+                "election", node=self.name, group=g.name, term=g.term,
+                detail="pre_vote round started",
+            )
             # start pre-vote host-side: queue the role scatter (batched
             # across groups at the next step), broadcast the rpc
             self._pending_roles.append((g.gid, C.R_PRE_VOTE))
@@ -2630,6 +2776,10 @@ class BatchCoordinator:
         g.term = max(g.term, msg.term)
         g.leader_slot = g.slot_of(msg.leader_id)
         g.snap_accept = None
+        self._obs_rec.record(
+            "snapshot_install", node=self.name, group=g.name, term=g.term,
+            detail=f"installed at index {meta.index} (term {meta.term})",
+        )
         gid = jnp.asarray([g.gid], jnp.int32)
         self.state = C.record_snapshot(
             self.state, gid, jnp.asarray([meta.index], jnp.int32),
@@ -2710,6 +2860,19 @@ class BatchCoordinator:
                 if now0 - last_tick >= self.tick_interval_s:
                     last_tick = now0
                     self._lane_watchdog(lane_watch, now0)
+                    # aggregate commit rate across all groups (the
+                    # batch-backend ra_li feed for system_overview /
+                    # placement decisions)
+                    applied_total = int(
+                        self._applied_np[: self.n_groups].sum()
+                    )
+                    prev = self._commit_li_prev
+                    self._commit_li_prev = (now0, applied_total)
+                    if prev is not None:
+                        rate = self._commit_li.sample(
+                            max(0, applied_total - prev[1]), now0 - prev[0]
+                        )
+                        self.counters.put("commit_rate", int(round(rate)))
                     ms = int(time.time() * 1000)
                     for i in range(self.n_groups):
                         g = self.groups[i]
@@ -2851,6 +3014,12 @@ class BatchCoordinator:
             strikes = st[3] + 1
             lane_watch[i] = (g.last_applied, oldest, now0, strikes)
             self.counters.incr("lane_wedges")
+            self._obs_rec.record(
+                "watchdog_strike", node=self.name, group=g.name,
+                term=g.term,
+                detail=f"strike {strikes}: oldest pending {oldest}, "
+                       f"applied {g.last_applied}",
+            )
             logger.warning(
                 "coordinator %s: command lane wedged for group %s "
                 "(oldest pending idx %d, applied %d, role %d, strike %d)",
@@ -2880,5 +3049,8 @@ class BatchCoordinator:
             "backend": "tpu_batch",
             "groups": self.n_groups,
             "steps": self.steps,
+            "sub_steps": self.sub_steps,
             "msgs": self.msgs_processed,
+            "commit_rate": self.counters.get("commit_rate"),
+            "counters": self.counters.to_dict(),
         }

@@ -23,6 +23,7 @@ because the batch coordinator reads its state out as arrays anyway.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ra_tpu import counters as ra_counters
@@ -280,6 +281,15 @@ class Server:
         self.counter = (
             ra_counters.new((cfg.cluster_name, cfg.server_id)) if cfg.counters_enabled else None
         )
+        # commit-latency stage histograms (per NODE, shared with any
+        # batch coordinator on it) + flight recorder; one in-flight
+        # sample per server: [idx, t_submit, t_append, t_durable,
+        # t_commit, t_apply] in monotonic ns (obs.COMMIT_STAGES)
+        from ra_tpu import obs as _obs
+
+        self._commit_h = _obs.commit_hists(self.id[1])
+        self._obs_rec = _obs.flight_recorder()
+        self._lat: Optional[list] = None
 
         # machine state: from snapshot if present, else init
         snap = log.read_snapshot()
@@ -490,6 +500,11 @@ class Server:
     def _become(self, role: str, effects: EffectList) -> None:
         prev = self.role
         self.role = role
+        if prev != role:
+            self._obs_rec.record(
+                "role_change", node=self.id[1], group=self.id[0],
+                term=self.current_term, detail=f"{prev}->{role}",
+            )
         if role == FOLLOWER:
             self.votes = set()
             self.pre_votes = set()
@@ -517,6 +532,13 @@ class Server:
             # "maybe": an immediate error to plain callers, a retry
             # target only for callers that opted into at-least-once.
             hint = self.leader_id if self.leader_id != self.id else None
+            if self.pending_replies:
+                self._obs_rec.record(
+                    "deposition", node=self.id[1], group=self.id[0],
+                    term=self.current_term,
+                    detail=f"{len(self.pending_replies)} pending futures "
+                           "answered 'maybe'",
+                )
             for fut in self.pending_replies.values():
                 effects.append(Reply(fut, ("maybe", hint)))
             self.pending_replies = {}
@@ -697,6 +719,10 @@ class Server:
                     effects.append(Reply(cmd.from_ref, ("reject", "overloaded")))
                 else:
                     self._c("commands_dropped_overload")
+                self._obs_rec.record(
+                    "admission_reject", node=self.id[1], group=self.id[0],
+                    term=self.current_term, detail=f"backlog={backlog}",
+                )
                 return
         if cmd.kind in (RA_JOIN, RA_LEAVE, RA_CLUSTER_CHANGE):
             if not self._append_cluster_cmd(cmd, effects):
@@ -705,6 +731,14 @@ class Server:
         entry = Entry(index=idx, term=self.current_term, cmd=cmd)
         self.log.append(entry)
         self._g("last_index", idx)
+        if cmd.ts is not None:
+            now_ns = time.monotonic_ns()
+            lat = self._lat
+            if lat is None or now_ns - lat[1] > 10_000_000_000:
+                # one in-flight commit-latency sample; a sample stranded
+                # >10s (leadership churn) is abandoned and replaced
+                self._lat = [idx, cmd.ts, now_ns, 0, 0, 0]
+                self._commit_h["submit_append"].record(now_ns - cmd.ts)
         if cmd.reply_mode == "after_log_append" and cmd.from_ref is not None:
             effects.append(Reply(cmd.from_ref, ("ok", (idx, self.current_term), self.id)))
         elif cmd.reply_mode == "await_consensus" and cmd.from_ref is not None:
@@ -796,6 +830,10 @@ class Server:
         3633-3688)."""
         written_idx, _ = self.log.last_written()
         self._g("last_written_index", written_idx)
+        lat = self._lat
+        if lat is not None and lat[3] == 0 and written_idx >= lat[0]:
+            lat[3] = time.monotonic_ns()
+            self._commit_h["append_durable"].record(lat[3] - lat[2])
         match = []
         for sid, p in self.cluster.items():
             if not p.is_voter():
@@ -809,6 +847,12 @@ class Server:
             # dec.new_commit_index, with the sort done once
             if self.log.fetch_term(agreed) == self.current_term:
                 self.commit_index = agreed
+                if (
+                    lat is not None and lat[3] and lat[4] == 0
+                    and agreed >= lat[0]
+                ):
+                    lat[4] = time.monotonic_ns()
+                    self._commit_h["durable_commit"].record(lat[4] - lat[3])
                 self._apply_to(agreed, effects=effects)
 
     def _evaluate_queries(self, effects: EffectList) -> None:
@@ -1155,6 +1199,10 @@ class Server:
                 mac.apply(meta, cmd.data, self.machine_state)
             )
             self.machine_state = state
+            lat = self._lat
+            if lat is not None and entry.index == lat[0] and lat[4]:
+                lat[5] = time.monotonic_ns()
+                self._commit_h["commit_apply"].record(lat[5] - lat[4])
             mac_effects = self._realise_log_effects(entry, mac_effects)
             if not discard:
                 # Client replies/notifications and most machine side
@@ -1289,6 +1337,14 @@ class Server:
         elif isinstance(mode, tuple) and mode and mode[0] == "notify":
             _, corr, who = mode
             notify.setdefault(who, []).append((corr, reply))
+        lat = self._lat
+        if lat is not None and entry.index == lat[0] and lat[5]:
+            # reply stage closes at reply/notify emission (the proc
+            # executes the effect immediately after this handler)
+            self._commit_h["apply_reply"].record(
+                time.monotonic_ns() - lat[5]
+            )
+            self._lat = None
 
     # ------------------------------------------------------------------
     # follower
@@ -1628,6 +1684,10 @@ class Server:
 
     def _call_for_election(self, effects: EffectList) -> EffectList:
         self._c("elections")
+        self._obs_rec.record(
+            "election", node=self.id[1], group=self.id[0],
+            term=self.current_term + 1, detail="candidate round started",
+        )
         self.current_term += 1
         self.voted_for = self.id
         self._persist_term_vote()
@@ -1910,6 +1970,12 @@ class Server:
             self.log.install_snapshot(msg.meta, machine_state)
         self.machine_state = machine_state
         self.effective_machine_version = msg.meta.machine_version
+        self._obs_rec.record(
+            "snapshot_install", node=self.id[1], group=self.id[0],
+            term=self.current_term,
+            detail=f"installed at index {msg.meta.index} "
+                   f"(term {msg.meta.term})",
+        )
         self.commit_index = max(self.commit_index, msg.meta.index)
         self.last_applied = max(self.last_applied, msg.meta.index)
         self._set_cluster(

@@ -1,0 +1,135 @@
+"""Observability smoke check (CI): run a short WAL-backed bench
+in-process (filling the wave/commit/WAL histograms under real load),
+then bring up a live 3-coordinator cluster, scrape the Prometheus
+exposition and the ``system_overview`` surface, and fail on missing or
+NaN metrics. Registered next to scripts/flake_gate.sh — the gate that
+keeps the metrics surface from silently rotting while the code it
+instruments evolves.
+
+Usage: JAX_PLATFORMS=cpu python scripts/obs_smoke.py [--groups N] [--cmds N]
+"""
+import argparse
+import math
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _check_exposition(text, errors, required) -> None:
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        val = line.rsplit(" ", 1)[-1]
+        try:
+            f = float(val)
+        except ValueError:
+            errors.append(f"unparseable sample value: {line!r}")
+            continue
+        if math.isnan(f) or math.isinf(f):
+            errors.append(f"NaN/inf sample: {line!r}")
+    for pat in required:
+        m = re.search(pat, text)
+        if m is None:
+            errors.append(f"missing metric: /{pat}/")
+        elif m.groups() and int(m.group(1)) == 0:
+            errors.append(f"zero-count metric: {m.group(0)}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=64)
+    ap.add_argument("--cmds", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from bench import bench_pipeline
+    from ra_tpu import api, leaderboard, obs
+    from ra_tpu.machine import SimpleMachine
+    from ra_tpu.ops import consensus as C
+    from ra_tpu.runtime.coordinator import BatchCoordinator
+
+    out = bench_pipeline(args.groups, args.cmds, wal=True)
+    print(f"obs_smoke: bench ran at {out['value']:.0f} cmd/s "
+          f"(p50 {out['p50_ms']} ms)", file=sys.stderr)
+
+    errors: list = []
+
+    # the bench filled the histograms (they outlive its teardown):
+    # every wave phase and all five commit stages must have fired
+    required_bench = (
+        [rf"ra_wave_bench0_{ph}_seconds_count (\d+)"
+         for ph, _ in obs.WAVE_PHASES]
+        + [rf"ra_commit_bench0_{st}_seconds_count (\d+)"
+           for st, _ in obs.COMMIT_STAGES]
+        + [r"ra_wal_\w+_fsync_seconds_count (\d+)",
+           r"ra_wal_\w+_batch_seconds_count (\d+)"]
+    )
+
+    # live cluster: counter vectors (deleted when a coordinator stops)
+    # and the one-call system_overview surface
+    leaderboard.clear()
+    coords = [
+        BatchCoordinator(f"obs{i}", capacity=8, num_peers=3) for i in range(3)
+    ]
+    for c in coords:
+        c.start()
+    try:
+        members = [("og0", f"obs{i}") for i in range(3)]
+        for c in coords:
+            c.add_group("og0", "obscl", members,
+                        SimpleMachine(lambda cm, s: s + cm, 0))
+        from ra_tpu.protocol import ElectionTimeout
+
+        coords[0].deliver(("og0", "obs0"), ElectionTimeout(), None)
+        deadline = time.time() + 30
+        while (
+            coords[0].by_name["og0"].role != C.R_LEADER
+            and time.time() < deadline
+        ):
+            time.sleep(0.02)
+        for _ in range(3):
+            api.process_command(("og0", "obs0"), 1)
+
+        text = api.prometheus_metrics()
+        required_live = required_bench + [
+            r"# TYPE ra_commit_rate gauge",
+            r"# TYPE ra_commands_rejected counter",
+            r"ra_lane_wedges",  # presence only: 0 is the healthy value
+        ]
+        _check_exposition(text, errors, required_live)
+
+        ov = api.system_overview("obs0")
+        for section in ("overview", "counters", "histograms", "clusters",
+                        "events"):
+            if not ov.get(section):
+                errors.append(f"system_overview section {section!r} empty")
+        ch = {
+            k[2] for k in ov["histograms"]
+            if isinstance(k, tuple) and k[0] == "commit"
+        }
+        missing = {st for st, _ in obs.COMMIT_STAGES} - ch
+        if missing:
+            errors.append(f"commit stages never recorded: {sorted(missing)}")
+        if not any(e["kind"] == "election" for e in ov["events"]):
+            errors.append("flight recorder holds no election event")
+    finally:
+        for c in coords:
+            c.stop()
+        leaderboard.clear()
+
+    if errors:
+        print("obs_smoke: FAIL", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(f"obs_smoke: PASS ({len(text.splitlines())} exposition lines, "
+          f"{len(ov['histograms'])} live histograms, "
+          f"{len(ov['events'])} recent events)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
