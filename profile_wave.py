@@ -15,7 +15,12 @@ and the WAL rows run on their own threads (concurrent with the loop);
 they are listed for attribution, not added to the share denominator.
 
 Usage: PYTHONPATH= JAX_PLATFORMS=cpu python profile_wave.py
-       [groups] [cmds] [--top N] [--cprofile]
+       [groups] [cmds] [--top N] [--cprofile] [--trace out.json]
+
+``--trace out.json`` additionally records every wave phase as a
+timeline span and dumps Chrome/Perfetto trace JSON (load in
+chrome://tracing or ui.perfetto.dev) — the view that shows wave-phase
+OVERLAP, which the share table cannot.
 """
 import argparse
 import sys
@@ -122,11 +127,18 @@ def phase_tables(nodes, top: int = 5) -> str:
     return "\n".join(tables)
 
 
-def main(groups=2048, cmds=24, top=5, cprofile=False) -> None:
+def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None) -> None:
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from bench import bench_pipeline
 
+    if trace:
+        # wave-phase timeline spans (Chrome/Perfetto JSON): the view
+        # that shows whether device_step overlaps host_egress — the
+        # verification surface for the step-pipelining refactor
+        from ra_tpu import obs
+
+        obs.trace_buffer().enable()
     t0 = time.perf_counter()
     pr = None
     if cprofile:
@@ -138,6 +150,13 @@ def main(groups=2048, cmds=24, top=5, cprofile=False) -> None:
     if pr is not None:
         pr.disable()
     dt = time.perf_counter() - t0
+    if trace:
+        from ra_tpu import api
+
+        n_spans = api.dump_trace(trace)
+        print(f"trace: {n_spans} span events -> {trace} "
+              f"(open in chrome://tracing or ui.perfetto.dev)",
+              file=sys.stderr)
     print(f"total wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
           f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms", file=sys.stderr)
     print(f"\n## profile_wave: {groups} groups x {cmds} cmds "
@@ -161,5 +180,9 @@ if __name__ == "__main__":
     ap.add_argument("--top", type=int, default=5)
     ap.add_argument("--cprofile", action="store_true",
                     help="also run under cProfile (the old default)")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="dump wave-phase spans as Chrome/Perfetto "
+                         "trace JSON to this path")
     args = ap.parse_args(_ARGS)
-    main(args.groups, args.cmds, top=args.top, cprofile=args.cprofile)
+    main(args.groups, args.cmds, top=args.top, cprofile=args.cprofile,
+         trace=args.trace)

@@ -158,11 +158,28 @@ def start_cluster(
 
 
 def delete_cluster(server_ids: Sequence[ServerId]) -> None:
-    for name, node_name in server_ids:
+    ids = [tuple(sid) for sid in server_ids]
+    # resolve the cluster name BEFORE deleting (the directory entries
+    # die with the servers): the leaderboard entry must go too, or
+    # system_overview/cluster_health join against a ghost cluster and
+    # clients keep getting routed at deleted members. Local deletes
+    # prune per member (node.delete_server -> leaderboard.forget_member);
+    # the sweep below covers members deleted on REMOTE nodes, whose
+    # forget_member ran against the remote process's table, not ours.
+    cluster = next(
+        (c for c in (_cluster_of(sid) for sid in ids) if c), None
+    )
+    for name, node_name in ids:
         try:
             _mgmt_route(node_name).delete_server(name)
         except (RaError, RuntimeError, TimeoutError, OSError):
             pass  # node gone entirely (or unreachable over mgmt)
+    if cluster is not None:
+        got = leaderboard.snapshot().get(cluster)
+        if got is not None and set(got[1]) <= set(ids):
+            # every remaining recorded member was deleted: drop the
+            # entry (a PARTIAL delete keeps it, minus the dead members)
+            leaderboard.clear(cluster)
 
 
 def restart_server(server_id: ServerId, overrides: Optional[dict] = None) -> ServerId:
@@ -650,8 +667,10 @@ def system_overview(node_name: str, last_events: int = 100) -> dict:
     machinery of docs/INTERNALS.md §13): the node overview, every
     registered counter vector WITH field kind/help, latency-histogram
     percentiles (wave phases, commit stages, WAL), per-cluster commit
-    rates, and the most recent flight-recorder events."""
+    rates, the node's per-group health scan (§14), and the most recent
+    flight-recorder events."""
     from ra_tpu import counters as _counters
+    from ra_tpu import health as _health
     from ra_tpu import obs as _obs
 
     return {
@@ -660,8 +679,73 @@ def system_overview(node_name: str, last_events: int = 100) -> dict:
         "counters": _counters.registry().describe_overview(),
         "histograms": _obs.histograms().overview(),
         "clusters": cluster_commit_rates(),
+        "health": _health.node_health(node_name),
         "events": _obs.flight_recorder().events(last=last_events),
     }
+
+
+def cluster_health(last_events: int = 0) -> dict:
+    """Machine-readable cluster health feed (docs/INTERNALS.md §14) —
+    the data source the placement/rebalancing layer (ROADMAP item 1)
+    consumes, and what ``scripts/ra_top.py`` renders. Merges every
+    registered node health scanner with the leaderboard:
+
+    - ``nodes``     — per-node scan summaries (anomaly counts, the
+      scans/fetches pair that proves the single-fetch discipline);
+    - ``clusters``  — leaderboard leader/members joined with every
+      replica's per-group gauge row (keyed ``group@node``);
+    - ``anomalies`` — all non-quiet rows, worst first (severity, then
+      the largest gap) — the top-of-the-pager view;
+    - ``events``    — optionally, the most recent flight-recorder
+      events (health transitions line up with elections/WAL failures).
+    """
+    from ra_tpu import health as _health
+    from ra_tpu import obs as _obs
+
+    nodes: Dict[str, dict] = {}
+    by_cluster: Dict[str, Dict[str, dict]] = {}
+    anomalies: List[dict] = []
+    for node, sc in sorted(_health.scanners().items()):
+        nodes[node] = sc.summary()
+        for row in sc.rows():
+            by_cluster.setdefault(row["cluster"], {})[
+                f"{row['group']}@{node}"
+            ] = row
+            if row["state"] != "quiet":
+                anomalies.append(row)
+    anomalies.sort(
+        key=lambda r: (
+            # severity is the scanner's state code (health.py: severity
+            # == code, higher worse) — one encoding, no parallel table
+            r["severity"],
+            max(r["commit_gap"], r["backlog"], r["match_gap"]),
+        ),
+        reverse=True,
+    )
+    lb = leaderboard.snapshot()
+    clusters = {}
+    for cl in set(lb) | set(by_cluster):
+        leader, members = lb.get(cl, (None, ()))
+        clusters[cl] = {
+            "leader": leader,
+            "members": list(members),
+            "groups": by_cluster.get(cl, {}),
+        }
+    out = {"nodes": nodes, "clusters": clusters, "anomalies": anomalies}
+    if last_events:
+        out["events"] = _obs.flight_recorder().events(last=last_events)
+    return out
+
+
+def dump_trace(path: str) -> int:
+    """Write the recorded wave-phase spans as Chrome/Perfetto trace
+    JSON (load via chrome://tracing or ui.perfetto.dev). Tracing is off
+    by default: call ``obs.trace_buffer().enable()`` (or run
+    ``profile_wave.py --trace out.json``) first. Returns the number of
+    span events written."""
+    from ra_tpu import obs as _obs
+
+    return _obs.trace_buffer().dump(path)
 
 
 def prometheus_metrics() -> str:

@@ -117,6 +117,39 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
      "feed for placement/leader-balancing decisions)"),
 ]
 
+# Per-node health-plane vector (name ("health", node_name); written
+# only by the node's health scanner on its detector/tick thread). The
+# scans==fetches invariant is the proof of the single-fetch-per-tick
+# discipline the overhead guard relies on.
+HEALTH_FIELDS: List[FieldSpec] = [
+    ("health_scans", "counter", "health scans run (one per tick)"),
+    ("health_fetches", "counter",
+     "device/host mirror fetch operations (== health_scans proves the "
+     "single-fetch-per-tick discipline)"),
+    ("health_transitions", "counter", "anomaly state transitions"),
+    ("health_stuck", "gauge", "groups currently classified stuck"),
+    ("health_lagging", "gauge", "groups currently classified lagging"),
+    ("health_flapping", "gauge", "groups currently classified flapping"),
+    ("health_quiet", "gauge",
+     "groups currently classified quiet (healthy)"),
+    ("health_max_commit_gap", "gauge",
+     "worst commit->apply gap across this node's groups"),
+    ("health_max_match_gap", "gauge",
+     "worst follower match gap across this node's led groups"),
+    ("health_max_backlog", "gauge",
+     "worst appended-but-unapplied admission backlog"),
+]
+
+# Per-watched-peer phi-accrual gauges (name ("phi", owner, target);
+# written by the detector on whatever thread evaluates it). phi is a
+# float: exported as phi * 1000 so the int64 slot keeps 3 decimals.
+DETECTOR_FIELDS: List[FieldSpec] = [
+    ("phi_milli", "gauge", "phi-accrual suspicion level x1000"),
+    ("phi_suspect", "gauge", "1 while the peer is suspected, else 0"),
+    ("phi_intervals", "gauge",
+     "learned liveness-cadence samples in window"),
+]
+
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [
     ("mem_tables_flushed", "counter", "memtable flush jobs"),
     ("entries_flushed", "counter", "entries flushed to segments"),

@@ -511,6 +511,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
         if overload and not model.failures:
             _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
+        anomalies = _capture_health(model.failures)
         if disk_faults:
             faults.disarm_all()
         for n in names:
@@ -519,21 +520,47 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             except Exception:  # noqa: BLE001
                 pass
         leaderboard.clear()
-    _dump_on_failure(model.failures, f"actor seed={seed}")
+    _dump_on_failure(model.failures, f"actor seed={seed}",
+                     anomalies=anomalies)
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
         ops=counts, final_model=dict(model.sure),
     )
 
 
-def _dump_on_failure(failures, label: str) -> None:
-    """Consistency/liveness failure -> dump the flight recorder: the
-    post-mortem event trace (elections, depositions, failpoint fires,
-    watchdog strikes) is what makes a nemesis flake debuggable."""
+def _capture_health(failures):
+    """Snapshot the health plane's anomaly rows while the cluster is
+    still up (called at teardown entry — the scanners unregister when
+    the nodes stop). Never raises: diagnostics must not mask the
+    original failure."""
+    if not failures:
+        return None
+    try:
+        return api.cluster_health().get("anomalies", [])
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _dump_on_failure(failures, label: str, anomalies=None) -> None:
+    """Consistency/liveness failure -> dump the flight recorder plus
+    the health plane's anomaly view: the post-mortem event trace
+    (elections, depositions, failpoint fires, watchdog strikes, health
+    transitions) and "which groups were stuck/lagging/flapping at
+    death" are what make a nemesis flake debuggable."""
     if failures:
+        import sys
+
         from ra_tpu import obs
 
         obs.flight_recorder().dump(header=f" [kv_harness {label}]")
+        if anomalies is not None:
+            print(f"-- cluster health at failure ({label}): "
+                  f"{len(anomalies)} anomalous groups --", file=sys.stderr)
+            for row in anomalies[:10]:
+                print(f"   {row['state']:<8s} {row['group']}@{row['node']} "
+                      f"commit_gap={row['commit_gap']} "
+                      f"backlog={row['backlog']} churn={row['churn']}",
+                      file=sys.stderr)
 
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
@@ -806,6 +833,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         if overload and not model.failures:
             _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
+        anomalies = _capture_health(model.failures)
         if disk_faults:
             faults.disarm_all()
         for c in coords.values():
@@ -821,7 +849,8 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
             shutil.rmtree(base, ignore_errors=True)
         leaderboard.clear()
-    _dump_on_failure(model.failures, f"batch seed={seed}")
+    _dump_on_failure(model.failures, f"batch seed={seed}",
+                     anomalies=anomalies)
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
         ops=counts, final_model=dict(model.sure),

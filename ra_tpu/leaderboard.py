@@ -46,3 +46,24 @@ def clear(cluster_name: Optional[str] = None) -> None:
             _tab.clear()
         else:
             _tab.pop(cluster_name, None)
+
+
+def forget_member(sid: ServerId) -> None:
+    """A server was DELETED (not just stopped): drop it from every
+    cluster entry, clearing the leader slot if it held it and removing
+    the whole entry once no members remain. Without this the table
+    never forgets deleted clusters and ``system_overview`` /
+    ``cluster_health`` join against ghosts forever (deleted-cluster
+    leak; the reference's ETS rows die with their owner process)."""
+    with _lock:
+        for cluster in list(_tab):
+            leader, members = _tab[cluster]
+            if sid != leader and sid not in members:
+                continue
+            members = tuple(m for m in members if m != sid)
+            if leader == sid:
+                leader = None
+            if members:
+                _tab[cluster] = (leader, members)
+            else:
+                del _tab[cluster]

@@ -13,7 +13,8 @@ Three primitives, all safe on the hot path:
 
 - :class:`FlightRecorder` — bounded ring buffer of structured events
   (role changes, elections, depositions, snapshot installs, watchdog
-  strikes, admission rejects, failpoint fires, WAL failures) with
+  strikes, admission rejects, failpoint fires, WAL failures, health
+  transitions, phi suspect/unsuspect flips) with
   monotonic timestamps, group id and term. Appends are lock-free
   (CPython: slot assignment is atomic; sequence numbers come from an
   ``itertools.count``, whose ``next`` is atomic), so any thread —
@@ -363,6 +364,173 @@ def record_event(kind: str, node: Optional[str] = None,
                  group: Optional[str] = None, term: Optional[int] = None,
                  detail: Any = None) -> None:
     _recorder.record(kind, node=node, group=group, term=term, detail=detail)
+
+
+# ---------------------------------------------------------------------------
+# trace buffer (Chrome/Perfetto trace-event export)
+
+
+class TraceBuffer:
+    """Bounded ring of completed phase spans, exported as Chrome trace
+    events (``chrome://tracing`` / Perfetto JSON) so wave-phase overlap
+    is VISIBLE on a timeline — the verification surface the coordinator
+    step-pipelining work (ROADMAP item 2) needs: histograms say how
+    long ``device_step`` takes, the trace shows whether it overlaps
+    ``host_egress`` of the previous step.
+
+    Span recording follows the flight-recorder discipline: lock-free
+    appends (atomic slot store + ``itertools.count``), timestamps from
+    ``time.perf_counter_ns()`` (the clock the wave loop already reads),
+    safe from any thread. Disabled by default — the step loop pays one
+    attribute check per step until ``enable()`` (profile_wave --trace,
+    tests, or an operator turning it on live)."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self.enabled = False
+        self._slots: List[Optional[Tuple]] = [None] * capacity
+        self._ctr = itertools.count()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        self._slots = [None] * self.capacity
+        self._ctr = itertools.count()
+
+    def span(self, name: str, pid: str, ts_ns: int, dur_ns: int,
+             tid: Optional[str] = None, cat: str = "wave") -> None:
+        """Record one completed span (begin at ``ts_ns``, ``dur_ns``
+        long; perf_counter_ns clock). ``pid`` groups lanes per node,
+        ``tid`` is the lane (defaults to the span name)."""
+        n = next(self._ctr)  # atomic in CPython
+        self._slots[n % self.capacity] = (
+            ts_ns, dur_ns, name, pid, tid or name, cat, n
+        )
+
+    def spans(self) -> List[Tuple]:
+        got = [s for s in list(self._slots) if s is not None]
+        got.sort(key=lambda s: (s[0], s[6]))
+        return got
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """Render the ring as a Chrome trace-event document: matched
+        B/E pairs per (pid, tid) lane plus process/thread metadata.
+        Timestamps are microsecond floats relative to the earliest
+        span (the format's expectation)."""
+        spans = self.spans()
+        events: List[Dict[str, Any]] = []
+        pids: Dict[str, int] = {}
+        tids: Dict[Tuple[str, str], int] = {}
+        t0 = spans[0][0] if spans else 0
+        for ts_ns, dur_ns, name, pid_s, tid_s, cat, _n in spans:
+            pid = pids.setdefault(pid_s, len(pids) + 1)
+            tkey = (pid_s, tid_s)
+            if tkey not in tids:
+                tids[tkey] = len(tids) + 1
+            tid = tids[tkey]
+            ts_us = (ts_ns - t0) / 1e3
+            events.append({"name": name, "cat": cat, "ph": "B",
+                           "ts": ts_us, "pid": pid, "tid": tid})
+            events.append({"name": name, "cat": cat, "ph": "E",
+                           "ts": ts_us + max(dur_ns, 0) / 1e3,
+                           "pid": pid, "tid": tid})
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": pid_s}}
+            for pid_s, pid in pids.items()
+        ] + [
+            {"name": "thread_name", "ph": "M", "pid": pids[pid_s],
+             "tid": tid, "args": {"name": tid_s}}
+            for (pid_s, tid_s), tid in tids.items()
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def dump(self, path: str) -> int:
+        """Write the Chrome trace JSON to ``path``; returns the number
+        of span events written (excluding metadata)."""
+        import json
+
+        doc = self.to_chrome()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+
+
+_trace = TraceBuffer()
+
+
+def trace_buffer() -> TraceBuffer:
+    return _trace
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Structural validation of a Chrome trace document (the obs_smoke
+    gate and the tests both run dumped files through this): span events
+    must carry numeric ts/pid/tid, every lane's B/E events must nest
+    and match, and each lane's begin timestamps must be monotone.
+    Returns a list of problems (empty == well-formed)."""
+    errors: List[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        return ["traceEvents missing or not a list"]
+    lanes: Dict[Tuple, List] = {}
+    for i, e in enumerate(doc["traceEvents"]):
+        ph = e.get("ph")
+        if ph == "M":
+            continue
+        if ph not in ("B", "E", "X", "i", "I"):
+            errors.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts != ts or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if not isinstance(e.get("pid"), int) or not isinstance(
+            e.get("tid"), int
+        ):
+            errors.append(f"event {i}: non-int pid/tid")
+            continue
+        lanes.setdefault((e["pid"], e["tid"]), []).append(
+            (ts, ph, e.get("name"), i)
+        )
+    for lane, evts in lanes.items():
+        stack: List[Tuple] = []
+        last_b = -1.0
+        for ts, ph, name, i in evts:  # events are emitted in ts order
+            if ph == "B":
+                if ts < last_b:
+                    errors.append(
+                        f"lane {lane}: non-monotone begin at event {i}"
+                    )
+                last_b = ts
+                stack.append((name, ts))
+            elif ph == "E":
+                if not stack:
+                    errors.append(f"lane {lane}: E without B at event {i}")
+                    continue
+                b_name, b_ts = stack.pop()
+                if name is not None and b_name != name:
+                    errors.append(
+                        f"lane {lane}: mismatched span {b_name!r}/"
+                        f"{name!r} at event {i}"
+                    )
+                if ts < b_ts:
+                    errors.append(
+                        f"lane {lane}: span {name!r} ends before it "
+                        f"begins at event {i}"
+                    )
+        if stack:
+            errors.append(
+                f"lane {lane}: {len(stack)} unmatched B events"
+            )
+    return errors
 
 
 # ---------------------------------------------------------------------------

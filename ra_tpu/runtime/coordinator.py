@@ -276,6 +276,7 @@ class BatchCoordinator:
         self.max_command_backlog = max_command_backlog
         self.command_deadline_s = command_deadline_s
         from ra_tpu import counters as _counters
+        from ra_tpu import health as _health
         from ra_tpu import obs as _obs
         from ra_tpu.li import LeakyIntegrator
 
@@ -288,6 +289,17 @@ class BatchCoordinator:
         self._wave_h = _obs.wave_hists(node_name)
         self._commit_h = _obs.commit_hists(node_name)
         self._obs_rec = _obs.flight_recorder()
+        # wave-phase trace spans land here when tracing is enabled
+        # (profile_wave --trace / api.dump_trace); one attribute check
+        # per step while disabled
+        self._trace = _obs.trace_buffer()
+        # per-group health scanner (docs/INTERNALS.md §14): fed once
+        # per tick from the detector thread with ONE device fetch over
+        # the existing mirrors — never from the step loop
+        self._health = _health.register(
+            node_name, backend="tpu_batch", capacity=max(64, capacity)
+        )
+        self._hslots: List[int] = []  # gid -> scanner slot
         # commit-latency sampling mask: groups with gid & mask == 0 are
         # eligible (bounds hot-path cost to ~1/64 of groups); _lat_gids
         # tracks the gids with a sample in flight so per-step sweeps
@@ -493,8 +505,10 @@ class BatchCoordinator:
         if self._started:
             self._step_thread.join(timeout=5)
         from ra_tpu import counters as _counters
+        from ra_tpu import health as _health
 
         _counters.delete(("coordinator", self.name))
+        _health.unregister(self.name)
         for g in self.groups:
             if g is not None:
                 for t in g.machine_timers.values():
@@ -641,6 +655,7 @@ class BatchCoordinator:
         for name, g in hosts:
             self.groups[g.gid] = g
             self.by_name[name] = g
+            self._hslots.append(self._health.ensure(name, g.cluster_name))
         self.n_groups += len(hosts)
         return sids
 
@@ -837,6 +852,18 @@ class BatchCoordinator:
             wh["device_step"].record(_t_dev - _t_pack)
             wh["host_egress"].record(_t_eg - _t_dev)
         wh["aer_fanout"].record(_t_aer - _t_eg)
+        tb = self._trace
+        if tb.enabled:
+            # same timestamps the histograms just consumed, as timeline
+            # spans: one lane per phase per node, so step-pipelining
+            # overlap (or its absence) is visible in Perfetto
+            node = self.name
+            tb.span("ingress_drain", node, _t_in, _t_drain - _t_in)
+            if _t_pack is not None:
+                tb.span("host_pack", node, _t_drain, _t_pack - _t_drain)
+                tb.span("device_step", node, _t_pack, _t_dev - _t_pack)
+                tb.span("host_egress", node, _t_dev, _t_eg - _t_dev)
+            tb.span("aer_fanout", node, _t_eg, _t_aer - _t_eg)
         return True
 
     def _pad(self, rows, width: int):
@@ -2873,6 +2900,7 @@ class BatchCoordinator:
                             max(0, applied_total - prev[1]), now0 - prev[0]
                         )
                         self.counters.put("commit_rate", int(round(rate)))
+                    self._health_scan(now0)
                     ms = int(time.time() * 1000)
                     for i in range(self.n_groups):
                         g = self.groups[i]
@@ -3030,6 +3058,60 @@ class BatchCoordinator:
                 ("lane_recover",) if strikes == 1 else ("lane_fail",),
                 None,
             )
+
+    def _health_scan(self, now: float) -> None:
+        """Per-group health pass (docs/INTERNALS.md §14), once per tick
+        on the detector thread: ONE device fetch over the existing
+        consensus mirrors (proven by the scans==fetches counter
+        invariant), then a fully vectorized gauge/anomaly update in
+        ra_tpu.health — no per-group Python loop, so the cost scales
+        with capacity at numpy speed, not with groups at Python speed."""
+        from ra_tpu import health as H
+
+        n = self.n_groups
+        if n == 0:
+            return
+        with self._state_lock:
+            st = self.state
+            # the fused step DONATES the state buffers, so a reference
+            # read outside the lock can die under us — but holding the
+            # lock across the host transfer would stall the step thread
+            # behind the async dispatch queue. Enqueue device-side
+            # COPIES under the lock (dispatch only, microseconds; the
+            # copies' buffers are fresh, never donated) ...
+            snap = tuple(jnp.copy(a) for a in (
+                st.current_term, st.commit_index, st.last_index, st.role,
+                st.leader_slot, st.self_slot, st.match_index, st.active,
+            ))
+        # ... and pay the transfer/queue wait OUTSIDE it: one
+        # device_get per scan (the health_fetches == health_scans
+        # counter invariant) with the step loop free to run
+        dev = jax.device_get(snap)
+        sc = self._health
+        sc.counters.incr("health_fetches")
+        term, commit, last, role, leader_slot, self_slot, match, active = (
+            a[:n] for a in dev
+        )
+        applied = self._applied_np[:n]
+        # follower match gap (leaders only): own tail minus the slowest
+        # ACTIVE peer's confirmed match, self slot excluded
+        cols = np.arange(match.shape[1])
+        peers = active & (cols[None, :] != self_slot[:, None])
+        slowest = np.where(
+            peers, match.astype(np.int64), np.iinfo(np.int64).max
+        ).min(axis=1)
+        is_leader = role == C.R_LEADER
+        has_peer = peers.any(axis=1)
+        match_gap = np.where(
+            is_leader & has_peer,
+            np.maximum(last.astype(np.int64) - slowest, 0), 0,
+        )
+        leader_key = np.where(
+            leader_slot >= 0, leader_slot.astype(np.int64), H.NO_LEADER_KEY
+        )
+        slots = np.asarray(self._hslots[:n], np.int64)
+        sc.scan(now, slots, role, term, applied, commit, last, match_gap,
+                leader_key)
 
     def _on_node_down(self, node_name: str) -> None:
         for i in range(self.n_groups):

@@ -134,9 +134,14 @@ class RaNode:
         # the window overflows, infra_down latches and healing stops
         self.infra_down = False
         self._infra_restarts: deque = deque()
+        from ra_tpu import health as ra_health
         from ra_tpu.detector import PhiAccrualDetector
 
-        self.detector = PhiAccrualDetector()
+        self.detector = PhiAccrualDetector(owner=name)
+        # per-group health scanner (docs/INTERNALS.md §14): the actor-
+        # backend mirror of the coordinator's vectorized scan, fed once
+        # per tick from the detector thread
+        self._health = ra_health.register(name, backend="per_group_actor")
         self._registry = nodes or node_registry()
         if tcp:
             # real sockets: name must be "host:port"; peers are remote
@@ -299,6 +304,7 @@ class RaNode:
         with self._lock:
             proc = self.procs.pop(name, None)
         if proc is not None:
+            self._health.release(name)  # restart re-learns from scratch
             proc.kill()
             bg = self._bg_actors.pop(proc.server.cfg.uid, None)
             if bg is not None:
@@ -328,8 +334,13 @@ class RaNode:
                 broadcast(sid)
 
     def delete_server(self, name: str) -> None:
+        from ra_tpu import leaderboard
+
         uid = self.directory.uid_of(name)
         self.stop_server(name)
+        # deletion (unlike stop/restart) removes the member for good:
+        # the leaderboard must not keep routing clients at the ghost
+        leaderboard.forget_member((name, self.name))
         if uid:
             self.directory.unregister(uid)
             self.meta.delete(uid)
@@ -638,12 +649,54 @@ class RaNode:
             logger.error("supervision: wal thread died; restarting log infra")
             self._on_wal_failure(RuntimeError("wal writer thread died"))
 
+    def _health_sweep(self, now: float) -> None:
+        """Actor-backend health scan (docs/INTERNALS.md §14): one host
+        sweep over the live procs' scalar mirrors (bounded by PROC
+        count, not group count — the thousands-of-groups path is the
+        coordinator's vectorized fetch), folded into the shared
+        vectorized scanner so both backends classify identically."""
+        import numpy as np
+
+        from ra_tpu import health as ra_health
+
+        rows = []
+        for name, proc in list(self.procs.items()):
+            try:
+                rows.append((name,) + proc.server.health_row())
+            except Exception:  # noqa: BLE001 — raced a restart: next tick
+                continue
+        if not rows:
+            return
+        sc = self._health
+        sc.counters.incr("health_fetches")  # one sweep == one fetch operation
+        slots = np.fromiter(
+            (sc.ensure(r[0], r[1]) for r in rows), np.int64, len(rows)
+        )
+        col = lambda i, dt: np.fromiter(  # noqa: E731
+            (r[i] for r in rows), dt, len(rows)
+        )
+        leader_key = np.fromiter(
+            (ra_health.NO_LEADER_KEY if r[8] is None else r[8]
+             for r in rows),
+            np.int64, len(rows),
+        )
+        sc.scan(
+            now, slots, col(2, np.int8), col(3, np.int64), col(4, np.int64),
+            col(5, np.int64), col(6, np.int64), col(7, np.int64), leader_key,
+        )
+
     def _detect_loop(self) -> None:
         import time as _t
 
+        last_health = 0.0
         while self.running:
             try:
                 self._supervise_log_infra()
+                _now_h = _t.monotonic()
+                if _now_h - last_health >= self.tick_interval_s:
+                    last_health = _now_h
+                    self._health_sweep(_now_h)
+                    self.detector.publish()
                 # include previously-seen names: a stopped node
                 # unregisters, and its disappearance must read as death
                 known = set(self.transport.known_nodes()) | set(self._node_status)
@@ -738,6 +791,17 @@ class RaNode:
 
     def stop(self) -> None:
         self.running = False
+        from ra_tpu import health as ra_health
+
+        ra_health.unregister(self.name)
+        # the detect loop publishes phi gauges: join it BEFORE closing
+        # the detector, or an in-flight publish() re-registers the
+        # gauge vectors close() just deleted (registry ghost)
+        try:
+            self._detector.join(timeout=2 * self._detector_poll_s + 1)
+        except RuntimeError:
+            pass  # stop() issued from the detector thread itself
+        self.detector.close()
         for name in list(self.procs):
             self.stop_server(name)
         self.wal.close()

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ra_tpu import counters as ra_counters
@@ -361,6 +362,36 @@ class Server:
             self.current_term = term
             self.voted_for = voted_for
             self._persist_term_vote()
+
+    # role -> ra_tpu.health role code (AWAIT_CONDITION/RECEIVE_SNAPSHOT
+    # report as "held": not a device role, but a health-relevant fact)
+    _HEALTH_ROLE = {FOLLOWER: 0, PRE_VOTE: 1, CANDIDATE: 2, LEADER: 3}
+
+    def health_row(self) -> Tuple:
+        """One row of the node's per-group health scan (the actor-
+        backend mirror of the coordinator's vectorized device fetch;
+        ra_tpu/health.py). Read by the detector thread between actor
+        turns: plain scalar reads, best-effort like the counters.
+        Returns (cluster, role_code, term, applied, commit, last_index,
+        match_gap, leader_key)."""
+        li, _ = self.log.last_index_term()
+        gap = 0
+        if self.role == LEADER:
+            pm = [
+                p.match_index for sid, p in self.cluster.items()
+                if sid != self.id and p.is_voter()
+            ]
+            if pm:
+                gap = max(0, li - min(pm))
+        leader = self.id if self.role == LEADER else self.leader_id
+        key = (
+            zlib.crc32(repr(leader).encode()) if leader is not None else None
+        )
+        return (
+            self.cfg.cluster_name, self._HEALTH_ROLE.get(self.role, 4),
+            self.current_term, self.last_applied, self.commit_index, li,
+            gap, key,
+        )
 
     def overview(self) -> Dict[str, Any]:
         li, lt = self.log.last_index_term()

@@ -1,18 +1,23 @@
 """Observability smoke check (CI): run a short WAL-backed bench
-in-process (filling the wave/commit/WAL histograms under real load),
-then bring up a live 3-coordinator cluster, scrape the Prometheus
-exposition and the ``system_overview`` surface, and fail on missing or
-NaN metrics. Registered next to scripts/flake_gate.sh — the gate that
-keeps the metrics surface from silently rotting while the code it
-instruments evolves.
+in-process (filling the wave/commit/WAL histograms under real load,
+with the trace buffer recording), then bring up a live 3-coordinator
+cluster, scrape the Prometheus exposition, the ``system_overview`` and
+``cluster_health`` surfaces, and fail on missing or NaN metrics; a
+dumped wave trace must also validate as well-formed Chrome trace JSON
+(matched B/E spans, monotone per-lane timestamps). Registered next to
+scripts/flake_gate.sh — the gate that keeps the instruments we debug
+liveness WITH from silently rotting while the code they instrument
+evolves.
 
 Usage: JAX_PLATFORMS=cpu python scripts/obs_smoke.py [--groups N] [--cmds N]
 """
 import argparse
+import json
 import math
 import os
 import re
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -46,16 +51,38 @@ def main() -> int:
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from bench import bench_pipeline
-    from ra_tpu import api, leaderboard, obs
+    from ra_tpu import api, counters, leaderboard, obs
     from ra_tpu.machine import SimpleMachine
     from ra_tpu.ops import consensus as C
     from ra_tpu.runtime.coordinator import BatchCoordinator
 
+    obs.trace_buffer().enable()  # record wave spans through the bench
     out = bench_pipeline(args.groups, args.cmds, wal=True)
+    obs.trace_buffer().disable()
     print(f"obs_smoke: bench ran at {out['value']:.0f} cmd/s "
           f"(p50 {out['p50_ms']} ms)", file=sys.stderr)
 
     errors: list = []
+
+    # the dumped trace must be well-formed Chrome trace JSON (matched
+    # B/E pairs, monotone per-lane begins) and actually hold spans
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "wave.json")
+        n_spans = api.dump_trace(trace_path)
+        if n_spans == 0:
+            errors.append("trace dump holds no spans after the bench")
+        try:
+            doc = json.load(open(trace_path))
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"trace dump is not JSON: {e}")
+        else:
+            errors.extend(obs.validate_chrome_trace(doc))
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e.get("ph") == "B"}
+            for ph, _h in obs.WAVE_STEP_PHASES:
+                if ph not in names:
+                    errors.append(f"trace has no {ph!r} spans")
+    obs.trace_buffer().clear()
 
     # the bench filled the histograms (they outlive its teardown):
     # every wave phase and all five commit stages must have fired
@@ -92,20 +119,73 @@ def main() -> int:
             time.sleep(0.02)
         for _ in range(3):
             api.process_command(("og0", "obs0"), 1)
+        # at least one health scan per node (tick cadence: 1s default),
+        # AND a scan recent enough to have seen the elected leader —
+        # rows snapshot the LAST scan, which may predate the election
+        def _health_ready():
+            for i in range(3):
+                c = counters.fetch(("health", f"obs{i}"))
+                if c is None or c.get("health_scans") < 1:
+                    return False
+            return any(
+                r["role"] == "leader"
+                for r in api.cluster_health()["clusters"]
+                .get("obscl", {}).get("groups", {}).values()
+            )
+
+        deadline = time.time() + 30
+        while time.time() < deadline and not _health_ready():
+            time.sleep(0.05)
 
         text = api.prometheus_metrics()
         required_live = required_bench + [
             r"# TYPE ra_commit_rate gauge",
             r"# TYPE ra_commands_rejected counter",
             r"ra_lane_wedges",  # presence only: 0 is the healthy value
+            # health plane families (docs/INTERNALS.md §14)
+            r"ra_health_scans\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_health_fetches\{[^}]*obs0[^}]*\} (\d+)",
+            r"# TYPE ra_health_stuck gauge",
+            r"ra_health_quiet\{[^}]*obs0[^}]*\} (\d+)",
         ]
         _check_exposition(text, errors, required_live)
 
         ov = api.system_overview("obs0")
         for section in ("overview", "counters", "histograms", "clusters",
-                        "events"):
+                        "health", "events"):
             if not ov.get(section):
                 errors.append(f"system_overview section {section!r} empty")
+
+        # cluster_health: every node scanning (single-fetch discipline
+        # proven by scans == fetches), the group joined under its
+        # cluster, all gauge values finite
+        ch = api.cluster_health()
+        for i in range(3):
+            s = ch["nodes"].get(f"obs{i}")
+            if s is None:
+                errors.append(f"cluster_health missing node obs{i}")
+                continue
+            if s["scans"] < 1:
+                errors.append(f"obs{i}: no health scans ran")
+            # fetches incr at tick start, scans at tick end: a read
+            # racing one in-flight tick may see fetches one ahead —
+            # anything else breaks the single-fetch-per-tick discipline
+            if not 0 <= s["fetches"] - s["scans"] <= 1:
+                errors.append(
+                    f"obs{i}: scans={s['scans']} vs fetches={s['fetches']} "
+                    f"(single-fetch-per-tick discipline broken)"
+                )
+        grp = ch.get("clusters", {}).get("obscl", {}).get("groups", {})
+        if "og0@obs0" not in grp:
+            errors.append("cluster_health did not join og0@obs0 under obscl")
+        for key, row in grp.items():
+            for fld in ("commit_gap", "match_gap", "backlog", "commit_rate",
+                        "churn", "leader_age_s"):
+                v = row.get(fld)
+                if not isinstance(v, (int, float)) or v != v:
+                    errors.append(f"{key}: bad {fld} value {v!r}")
+        if not any(r["role"] == "leader" for r in grp.values()):
+            errors.append("cluster_health shows no leader row for obscl")
         ch = {
             k[2] for k in ov["histograms"]
             if isinstance(k, tuple) and k[0] == "commit"

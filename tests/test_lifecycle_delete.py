@@ -107,6 +107,49 @@ def test_cluster_is_deleted_everywhere(tmp_path):
         leaderboard.clear()
 
 
+def test_deleted_cluster_leaves_no_leaderboard_ghost(tmp_path):
+    """Regression (ISSUE 7 satellite): the leaderboard never forgot
+    deleted clusters, so system_overview/cluster_health joined against
+    ghosts forever and clients kept getting routed at deleted members."""
+    from ra_tpu.runtime.transport import registry
+
+    leaderboard.clear()
+    nodes = ["lgA", "lgB", "lgC"]
+    for n in nodes:
+        api.start_node(n, SystemConfig(name=n, data_dir=str(tmp_path / n)),
+                       election_timeout_s=0.1, tick_interval_s=0.05)
+    members = [("g1", n) for n in nodes]
+    try:
+        api.start_cluster("lgc", counter, members)
+        api.process_command(members[0], 1, timeout=10)
+        assert leaderboard.lookup_leader("lgc") is not None
+        api.delete_cluster(members)
+        assert leaderboard.lookup_leader("lgc") is None
+        assert "lgc" not in leaderboard.snapshot()
+        assert leaderboard.lookup_members("lgc") == ()
+        # the joined surfaces see no ghost either
+        assert "lgc" not in api.cluster_commit_rates()
+        assert not api.cluster_health()["clusters"].get("lgc", {}).get(
+            "groups"
+        )
+        # deleting a SINGLE member prunes just that member (and clears
+        # a leader slot it held) rather than the whole entry
+        api.start_cluster("lgc2", counter, members)
+        leader = api.wait_for_leader("lgc2")
+        api.delete_cluster([leader])
+        left = leaderboard.snapshot().get("lgc2")
+        assert left is not None
+        assert left[0] is None or left[0] != leader
+        assert leader not in left[1] and len(left[1]) == 2
+    finally:
+        for n in nodes:
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
+
+
 def test_delete_during_pending_segment_flush(tmp_path):
     """Deleting a server with rolled-over-but-unflushed WAL entries must
     not let the segment writer recreate its data dir or crash
