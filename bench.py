@@ -80,14 +80,28 @@ def _retry_on_cpu_or_fail() -> None:
 
 
 def bench_pipeline(groups: int, cmds: int, wal: bool = True,
-                   workdir: str = None) -> dict:
-    """Cooperative-scheduler pipeline bench: the three coordinators are
-    stepped round-robin from this thread (their threaded step loops are
-    never started; the WAL batching/fsync threads DO run). On a
-    multi-core host the threaded mode adds parallelism, but the
-    driver's bench box has one core, where thread ping-pong only adds
-    GIL handoff latency; the message flow and the per-step work are
-    identical either way (docs/INTERNALS.md, bench methodology)."""
+                   workdir: str = None, pipeline="on") -> dict:
+    """Multi-raft pipeline bench. Modes (``pipeline``):
+
+    - ``"on"`` (default): the pipelined wave loop in its cooperative
+      stage/finish form — every round stages + DISPATCHES all three
+      coordinators' fused device steps, then realises them, so each
+      device step (and the WAL fsyncs behind the decoupled durable
+      acks) overlaps the other coordinators' host staging. One driver
+      thread: on a CPU host the wave is GIL-bound, and thread
+      round-robin only adds handoff latency (measured: the threaded
+      loop below).
+    - ``"off"``: the sequential A/B control — step_once round-robin
+      (the pre-pipelining methodology), ingress-routed durable acks.
+    - ``"threaded"``: each coordinator's started two-stage loop (step
+      thread + egress thread); the driver only delivers and polls.
+      The production shape (kv_harness runs it) — recorded as the
+      threaded-loop secondary artifact each perf round."""
+    if pipeline is True:
+        pipeline = "on"
+    elif pipeline is False:
+        pipeline = "off"
+    assert pipeline in ("on", "off", "threaded")
     import jax
     import jax.numpy as jnp
 
@@ -126,7 +140,8 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
     from ra_tpu.runtime.coordinator import BatchCoordinator
 
     coords = [
-        BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3, idle_sleep_s=0)
+        BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3,
+                         idle_sleep_s=0, pipeline=pipeline != "off")
         for i in range(3)
     ]
     storage = []
@@ -148,22 +163,31 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             d = os.path.join(base, f"bench{i}")
             tables = TableRegistry()
 
-            def notify(uid, evt, c=c, i=i):
-                c.deliver((uid, f"bench{i}"), ("log_event", evt), None)
+            if pipeline != "off":
+                # decoupled durable acks (docs/INTERNALS.md §15):
+                # written events are handled on the WAL writer thread
+                # itself — watermark advance, deferred AER ack out,
+                # device scatter queued — instead of riding ingress to
+                # the next step-loop pass
+                notify = c.wal_notify
+                notify_many = c.wal_notify_many
+            else:
+                # A/B control: the pre-pipelining ingress-routed events
+                def notify(uid, evt, c=c, i=i):
+                    c.deliver((uid, f"bench{i}"), ("log_event", evt), None)
 
+                def notify_many(items, c=c, i=i):
+                    c.deliver_many(
+                        [((uid, f"bench{i}"), ("log_event", evt), None)
+                         for uid, evt in items]
+                    )
             sw = SegmentWriter(os.path.join(d, "data"), tables, notify)
             # big batches: fewer fsyncs AND fewer written-event rounds
             # per pipelined burst (one event per group per batch)
             w = Wal(os.path.join(d, "wal"), tables, notify,
                     segment_writer=sw, max_batch_size=65536)
-            # bulk written-event channel: one ingress lock round per
-            # fsync batch instead of one per group
-            w.notify_many = (
-                lambda items, c=c, i=i: c.deliver_many(
-                    [((uid, f"bench{i}"), ("log_event", evt), None)
-                     for uid, evt in items]
-                )
-            )
+            # bulk written-event channel: one lock round per fsync batch
+            w.notify_many = notify_many
             storage.append((tables, w, sw, d, base))
 
         def mk_log(i, uid):
@@ -183,11 +207,52 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             [((f"g{g}", "bench0"), ElectionTimeout(), None) for g in range(groups)]
         )
 
-        def step_all() -> bool:
-            worked = False
+        if pipeline == "on":
+            # cooperative PIPELINED stepping: each round stages +
+            # dispatches EVERY coordinator's next device step, then
+            # realises them all — each device step (and the WAL fsyncs
+            # behind the decoupled acks) computes while the driver
+            # stages the other coordinators' host work. One driver
+            # thread, no GIL thrash (the threaded two-stage loop serves
+            # the production path; kv_harness runs it pipelined).
+            def step_all() -> bool:
+                worked = False
+                for c in coords:
+                    worked = c.step_stage() or worked
+                for c in coords:
+                    worked = c.step_finish() or worked
+                return worked
+        elif pipeline == "threaded":
             for c in coords:
-                worked = c.step_once() or worked
-            return worked
+                c.start()
+
+            def step_all() -> bool:
+                time.sleep(0.0005)
+                return False
+        else:
+            def step_all() -> bool:
+                worked = False
+                for c in coords:
+                    worked = c.step_once() or worked
+                return worked
+
+        def settle() -> None:
+            """Quiesce: cooperative modes step until nothing moves; the
+            threaded mode waits for the apply floors to sit still."""
+            if pipeline != "threaded":
+                while step_all():
+                    pass
+                return
+            last, last_t = None, time.time()
+            while time.time() - last_t < 120:
+                cur = tuple(
+                    int(c._applied_np[:groups].sum()) for c in coords
+                )
+                if cur != last:
+                    last, last_t = cur, time.time()
+                elif time.time() - last_t >= 0.05:
+                    return
+                time.sleep(0.005)
 
         def all_leaders() -> bool:
             by = coords[0].by_name
@@ -203,8 +268,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
 
         # settle all in-flight work (election noops) so the applied
         # floor below is exact
-        while step_all():
-            pass
+        settle()
         import numpy as np
 
         from ra_tpu import obs
@@ -226,8 +290,15 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
 
         base = coords[0]._applied_np[:groups].copy()
         names = [f"g{g}" for g in range(groups)]
-        # fixed sample of groups for the commit-latency distribution
+        # fixed sample of groups for the LOADED-latency distributions
         sample = np.arange(0, groups, max(1, groups // 256), dtype=np.int64)
+        # unloaded-latency probe: 64-group waves rotating over the fleet
+        # so every group is sampled (BENCH_r07's 256-wide fixed slice
+        # both self-loaded the probe and collapsed the tail to 8
+        # effective samples — a wave's groups commit together)
+        lat_w = min(64, groups)
+        lat_stride = max(1, groups // lat_w)
+        lat_sample = np.arange(0, groups, lat_stride, dtype=np.int64)
 
         def run_wave(n_waves: int, loaded_hist=None) -> None:
             """Pre-queue ``n_waves`` full-fleet waves (the UNBOUNDED
@@ -236,6 +307,13 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             wave_t: list = []
             base0 = base[sample].copy()
+            if pipeline == "threaded":
+                # real-time election noops can advance the applied-index
+                # floor past ``base`` before every user command of the
+                # wave has applied, so the floor alone cannot terminate
+                # a threaded pass: the machine mirrors must agree too
+                by = coords[0].by_name
+                mstate0 = [by[n].machine_state for n in names]
             for w in range(n_waves):
                 base.__iadd__(1)
                 wave_t.append(time.perf_counter())
@@ -264,7 +342,11 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                             loaded_hist.record_seconds(now - wave_t[k])
                         done_w[s] = newly[s]
                 if all((c._applied_np[:groups] >= base).all() for c in coords):
-                    return
+                    if pipeline != "threaded" or all(
+                        by[names[g]].machine_state - mstate0[g] >= n_waves
+                        for g in range(groups)
+                    ):
+                        return
             raise TimeoutError("wave did not complete")
 
         def run_wave_admitted(n_waves: int, window: int, hist) -> None:
@@ -340,8 +422,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             competition with the bench's own earlier traffic."""
             end = time.time() + timeout_s
             while time.time() < end:
-                while step_all():
-                    pass
+                settle()
                 if all(
                     not w._queue and sw.wait_idle(timeout=0.0)
                     for _t, w, sw, _d, _b in storage
@@ -358,39 +439,58 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         sys.setswitchinterval(0.0002)
 
         def latency_phase(n_waves: int):
-            """p50/p99 commit latency: the sampled groups (256 of them)
-            each issue ONE command while the other ~10k groups sit idle;
-            latency = delivery -> leader apply per sampled group. This
-            is the unloaded commit round trip (append, replicate, fsync
-            on three logs, quorum, apply) — the reference's
-            commit-latency gauge measures the same thing per entry. It
-            runs BEFORE the saturation passes (after a storage drain):
-            measuring it after them would time the segment writers
-            digesting the passes' backlog, not commit latency. The
-            passes report their own LOADED latency distribution."""
+            """p50/p99 commit latency: each wave issues ONE command to a
+            ``lat_w``-group slice while the rest of the fleet sits
+            idle; latency = delivery -> leader apply per sampled group.
+            The slice ROTATES across waves so over the full phase every
+            group of the fleet is sampled (BENCH_r07's p90==p99==p99.9
+            collapse came from 8 waves over one fixed 256-group slice:
+            a wave's groups commit together, so the effective tail
+            sample was 8, not 2048 — and the wide slice self-loaded
+            the probe). This is the unloaded commit round trip (append,
+            replicate, fsync on three logs, quorum, apply) — the
+            reference's commit-latency gauge measures the same thing
+            per entry. It runs BEFORE the saturation passes (after a
+            storage drain): measuring it after them would time the
+            segment writers digesting the passes' backlog, not commit
+            latency. The passes report their own LOADED latency
+            distribution."""
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
-            sample_names = [f"g{g}" for g in sample]
-            for _ in range(n_waves):
-                base[sample] += 1
-                done = np.zeros(len(sample), bool)
+            stride = lat_stride
+            for k in range(n_waves):
+                rot = (lat_sample + (k % stride)) % groups
+                rot_names = [f"g{g}" for g in rot]
+                base[rot] += 1
+                done = np.zeros(len(rot), bool)
                 t0 = time.perf_counter()
                 coords[0].deliver_commands(
-                    sample_names, cmd._replace(ts=time.monotonic_ns())
+                    rot_names, cmd._replace(ts=time.monotonic_ns())
                 )
+                # measured loop: leader applies only (the latency
+                # definition stops at leader apply; the fleet-wide
+                # settle below is bookkeeping, not measurement)
                 while time.time() < deadline:
                     if not step_all():
                         # idle: the round trip is waiting on a WAL
                         # fsync thread — hand it the core immediately
                         time.sleep(0)
                     now = time.perf_counter()
-                    newly = ~done & (coords[0]._applied_np[sample] >= base[sample])
+                    newly = ~done & (coords[0]._applied_np[rot] >= base[rot])
                     if newly.any():
                         h_unloaded.record_seconds(now - t0, count=int(newly.sum()))
                         done |= newly
-                    if all((c._applied_np[:groups] >= base).all() for c in coords):
-                        break
+                        if done.all():
+                            break
                 else:
                     raise TimeoutError("latency wave did not complete")
+                # settle followers (commit-sync round) before next wave
+                while not all(
+                    (c._applied_np[:groups] >= base).all() for c in coords
+                ):
+                    if time.time() >= deadline:
+                        raise TimeoutError("latency wave did not settle")
+                    if not step_all():
+                        time.sleep(0)
 
         try:
             run_wave(1)  # warmup: compiles remaining scatter/step shapes
@@ -405,8 +505,11 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # discard the warmup latency_phase(1) samples (compile/cold-path
         # time); the throughput warmup run_wave(1) records nothing here
         h_unloaded.reset()
+        # enough rotating waves to sample EVERY group once at 10k
+        # groups (160 x 64), floor 8 for small fleets
+        lat_waves = max(8, min(160, lat_stride))
         try:
-            latency_phase(8)
+            latency_phase(lat_waves)
         except TimeoutError:
             print("bench error: latency phase incomplete", file=sys.stderr)
             _retry_on_cpu_or_fail()
@@ -467,7 +570,13 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 for g in range(groups)
             )
             if bad:
-                print(f"bench error: {bad}/{groups} groups wrong state",
+                adv = [
+                    coords[0].by_name[f"g{g}"].machine_state - state0[g]
+                    for g in range(groups)
+                ]
+                print(f"bench error: {bad}/{groups} groups wrong state "
+                      f"(expected +{cmds}; advance min={min(adv)} "
+                      f"max={max(adv)})",
                       file=sys.stderr)
                 _retry_on_cpu_or_fail()
             best = max(best, total / dt)
@@ -496,13 +605,24 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             "metric": (
                 f"durable replicated commands/sec ({groups} groups x 3 "
                 f"replicas, {'shared-WAL fsync-gated logs' if wal else 'in-memory logs (routing ceiling)'}, "
-                f"tpu_batch coordinators, device {jax.devices()[0].platform}, "
-                f"best of 3 passes; p50/p99 = unloaded commit latency, "
+                f"tpu_batch coordinators, "
+                + {
+                    "on": "pipelined wave loop (coop stage/finish) + "
+                          "decoupled durable acks",
+                    "threaded": "pipelined wave loop (started two-stage "
+                                "threads) + decoupled durable acks",
+                    "off": "sequential cooperative loop (control)",
+                }[pipeline] + ", "
+                f"device {jax.devices()[0].platform}, "
+                f"best of 3 passes; p50/p99 = unloaded commit latency "
+                f"over {lat_waves} rotating {lat_w}-group waves "
+                f"({lat_waves * lat_w} samples, every group sampled at "
+                f"full fleet), "
                 f"loaded_p50/p99 = delivery->apply with client admission "
                 f"({ADMIT_WINDOW} slice of groups/16 lanes in flight), "
-                f"unbounded_loaded_* = the pre-queued comparison shape, "
-                f"all over {len(sample)} sampled groups)"
+                f"unbounded_loaded_* = the pre-queued comparison shape)"
             ),
+            "pipeline": pipeline,
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
@@ -621,6 +741,14 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--workdir", default=None,
                     help="WAL/segment directory (default: temp dir)")
+    ap.add_argument("--pipeline", choices=("on", "off", "threaded"),
+                    default="on",
+                    help="on (default): cooperative pipelined stage/"
+                         "finish stepping + decoupled durable acks; "
+                         "off: the sequential cooperative control (A/B "
+                         "is this one flag); threaded: started "
+                         "two-stage loops (the production shape, "
+                         "recorded as a secondary artifact)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -635,7 +763,8 @@ def main() -> None:
         # batch cap (128) still bounds every RPC
         g = args.groups or (128 if args.smoke else 10240)
         out = bench_pipeline(g, args.cmds or (3 if args.smoke else 96),
-                             wal=not args.no_wal, workdir=args.workdir)
+                             wal=not args.no_wal, workdir=args.workdir,
+                             pipeline=args.pipeline)
     print(json.dumps(out))
 
 

@@ -127,7 +127,8 @@ def phase_tables(nodes, top: int = 5) -> str:
     return "\n".join(tables)
 
 
-def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None) -> None:
+def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None,
+         pipeline="on") -> None:
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from bench import bench_pipeline
@@ -146,7 +147,7 @@ def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None) -> None:
 
         pr = cProfile.Profile()
         pr.enable()
-    out = bench_pipeline(groups, cmds, wal=True)
+    out = bench_pipeline(groups, cmds, wal=True, pipeline=pipeline)
     if pr is not None:
         pr.disable()
     dt = time.perf_counter() - t0
@@ -160,8 +161,8 @@ def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None) -> None:
     print(f"total wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
           f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms", file=sys.stderr)
     print(f"\n## profile_wave: {groups} groups x {cmds} cmds "
-          f"(WAL-backed, {out['value']:.0f} cmd/s, unloaded "
-          f"p50 {out['p50_ms']} ms)\n")
+          f"(WAL-backed, pipeline={pipeline}, {out['value']:.0f} cmd/s, "
+          f"unloaded p50 {out['p50_ms']} ms)\n")
     print(phase_tables([f"bench{i}" for i in range(3)], top=top))
     if pr is not None:
         import io
@@ -183,6 +184,11 @@ if __name__ == "__main__":
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="dump wave-phase spans as Chrome/Perfetto "
                          "trace JSON to this path")
+    ap.add_argument("--pipeline", choices=("on", "off", "threaded"),
+                    default="on",
+                    help="wave-loop mode (matches bench.py --pipeline); "
+                         "run once with on and once with off for the "
+                         "A/B attribution tables")
     args = ap.parse_args(_ARGS)
     main(args.groups, args.cmds, top=args.top, cprofile=args.cprofile,
-         trace=args.trace)
+         trace=args.trace, pipeline=args.pipeline)

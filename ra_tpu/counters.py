@@ -84,6 +84,13 @@ WAL_FIELDS: List[FieldSpec] = [
     ("out_of_seq", "counter", "out-of-sequence writes detected"),
     ("rollovers", "counter", "WAL file rollovers"),
     ("failures", "counter", "I/O failures (WAL entered failed state)"),
+    ("group_commit_waits", "counter",
+     "flushes that held the batch open coalescing an arriving burst "
+     "(adaptive group commit; docs/INTERNALS.md §15)"),
+    ("group_commit_delay_us", "gauge",
+     "coalescing delay of the last flush (us; 0 = flushed immediately)"),
+    ("native_batches", "counter",
+     "batches persisted via the native serialize+write+fsync path"),
 ]
 
 # Flow-control / liveness counters for a batch coordinator's command
@@ -115,6 +122,15 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
      "aggregate applied-entries/sec across this coordinator's groups "
      "(leaky-integrator smoothed, sampled per tick — the batch-backend "
      "feed for placement/leader-balancing decisions)"),
+    ("pipeline_steps", "counter",
+     "device steps dispatched via the pipelined wave loop (stage/"
+     "finish drivers or the started two-stage loop); pair with "
+     "pipeline_overlap_ns for how much host work each hid"),
+    ("pipeline_overlap_ns", "counter",
+     "host staging time (ingress drain + pack + dispatch) spent while "
+     "a previous step's device compute / egress realisation was still "
+     "in flight — the overlap the pipelined wave loop creates; 0 on "
+     "the sequential loop (docs/INTERNALS.md §15)"),
 ]
 
 # Per-node health-plane vector (name ("health", node_name); written

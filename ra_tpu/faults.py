@@ -143,6 +143,15 @@ def armed_sites() -> Dict[str, Tuple[Tuple, Tuple]]:
         return {s: (fp.action, fp.trigger) for s, fp in _armed.items()}
 
 
+def any_armed(*sites: str) -> bool:
+    """True when any of ``sites`` has a live failpoint. Lock-free (the
+    production fast path: hot loops route around accelerated paths only
+    while injection is actually armed)."""
+    if not _armed:
+        return False
+    return any(s in _armed for s in sites)
+
+
 def stats(site: str) -> Tuple[int, int]:
     """(hits, fires) for an armed site; (0, 0) when not armed."""
     fp = _armed.get(site)

@@ -594,11 +594,19 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         def notify(uid, evt):
             c = coord_ref.get("c")
             if c is not None:
-                c.deliver((uid, n), ("log_event", evt), None)
+                # decoupled durable-ack path (docs/INTERNALS.md §15):
+                # written events are handled on the WAL writer thread
+                c.wal_notify(uid, evt)
+
+        def notify_many(items):
+            c = coord_ref.get("c")
+            if c is not None:
+                c.wal_notify_many(items)
 
         sw = SegmentWriter(f"{d}/data", tables, notify)
         sw.fault_scope = n
         wal = Wal(f"{d}/wal", tables, notify, segment_writer=sw)
+        wal.notify_many = notify_many
         wal.fault_scope = n
         meta = FileMeta(f"{d}/meta.dat")
         meta.fault_scope = n

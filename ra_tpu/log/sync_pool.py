@@ -17,8 +17,18 @@ from typing import Dict, List, Optional
 
 
 class SyncPool:
-    def __init__(self, workers: Optional[int] = None):
+    def __init__(self, workers: Optional[int] = None,
+                 coalesce_window_s: float = 0.001):
         n = workers or max(1, (os.cpu_count() or 1) // 4)
+        # group-commit analog for snapshot syncs (docs/INTERNALS.md
+        # §15): when a request arrives on the heels of another (a
+        # snapshot burst across servers), hold it open briefly so
+        # same-path joiners ride ONE fsync. Bounded and only armed
+        # while a burst is evidently in progress — a lone sync pays
+        # nothing.
+        self.coalesce_window_s = coalesce_window_s
+        self._last_req_t = float("-inf")
+        self._req_gap = float("inf")  # arrival gap of the newest request
         self._cv = threading.Condition()
         self._queue: deque = deque()  # (path, Event, err_slot)
         self._closed = False
@@ -43,6 +53,11 @@ class SyncPool:
                 # degrades
                 self._fsync(path)
                 return
+            import time as _time
+
+            now = _time.monotonic()
+            self._req_gap = now - self._last_req_t
+            self._last_req_t = now
             self._queue.append((path, done, slot))
             self._cv.notify()
         if not done.wait(timeout):
@@ -60,6 +75,8 @@ class SyncPool:
             os.close(fd)
 
     def _run(self) -> None:
+        import time as _time
+
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
@@ -67,6 +84,18 @@ class SyncPool:
                 if self._closed and not self._queue:
                     return
                 path, done, slot = self._queue.popleft()
+                # adaptive coalescing: if another request landed within
+                # the window just before this one, a burst is in
+                # flight — hold briefly so its same-path joiners ride
+                # this fsync (never armed for an isolated request)
+                w = self.coalesce_window_s
+                if (
+                    w > 0 and not self._closed and not self._queue
+                    and self._req_gap < 4 * w
+                ):
+                    # the newest request followed its predecessor
+                    # closely: a burst — an isolated sync never waits
+                    self._cv.wait(timeout=w)
                 # batch: everyone queued behind us for the SAME path is
                 # satisfied by this one fsync
                 extra: List = []

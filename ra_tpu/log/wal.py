@@ -84,6 +84,8 @@ class Wal:
         threaded: bool = True,
         counter=None,
         native: bool = True,
+        group_commit_max_delay_s: float = 0.002,
+        group_commit_min_gain: int = 8,
     ):
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
@@ -110,7 +112,18 @@ class Wal:
 
             native = _native.available()
         self._native = native
-        self.counter = counter or ra_counters.Counters("wal", ra_counters.WAL_FIELDS)
+        # adaptive group commit (docs/INTERNALS.md §15): a flush may
+        # hold its batch open for up to ``group_commit_max_delay_s``
+        # while a burst is still arriving, so the burst pays ONE fsync.
+        # The wait is entered only when the smoothed arrival rate
+        # predicts at least ``group_commit_min_gain`` more entries
+        # within the bound — an idle write never waits on a timer.
+        self.group_commit_max_delay_s = group_commit_max_delay_s
+        self.group_commit_min_gain = group_commit_min_gain
+        from ra_tpu.li import LeakyIntegrator
+
+        self._gc_rate = LeakyIntegrator()
+        self._gc_t = time.monotonic()
         # fsync-wait and batch-flush histograms (docs/INTERNALS.md §13);
         # keyed by the WAL directory's basename so every WAL in a
         # multi-node process exports its own distribution
@@ -122,6 +135,12 @@ class Wal:
             f"{_parent}/{os.path.basename(_norm)}" if _parent
             else (os.path.basename(_norm) or "wal")
         )
+        self._scope = _scope
+        # registered vector (scrapeable): the group-commit delay gauge
+        # and flush counters ride the same exposition as the histograms
+        self.counter = counter or ra_counters.new(
+            ("wal", _scope), ra_counters.WAL_FIELDS
+        )
         self._h_fsync = _obs.histogram(
             ("wal", _scope, "fsync"), help="WAL fsync/fdatasync wait"
         )
@@ -129,7 +148,15 @@ class Wal:
             ("wal", _scope, "batch"),
             help="WAL batch flush (frame + write + fsync + notify)",
         )
+        self._h_flush_wait = _obs.histogram(
+            ("wal", _scope, "flush_wait"),
+            help="adaptive group-commit coalescing wait before a flush",
+        )
         self._obs_rec = _obs.flight_recorder()
+        # batch flushes land on the wave timeline too (their own lane
+        # per WAL scope) so Perfetto shows fsync work overlapping the
+        # coordinator's device/host phases; one attr check while off
+        self._trace = _obs.trace_buffer()
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -180,7 +207,12 @@ class Wal:
             if self._closed or self._failed:
                 return False
             self._queue.append(("s" if sparse else "w", uid, idx, term, payload, tid))
-            self._cv.notify()
+            if len(self._queue) == 1:
+                # a non-empty queue already has a wakeup in flight (or
+                # the writer is mid-flush and re-checks before waiting);
+                # per-append notifies were a measurable share of a
+                # 10k-group wave's enqueue fan-out
+                self._cv.notify()
         return True
 
     def write_run(self, uid: str, first: int, terms, payloads, tid: int = 0) -> bool:
@@ -197,7 +229,8 @@ class Wal:
             if self._closed or self._failed:
                 return False
             self._queue.append(("r", uid, first, terms, payloads, tid))
-            self._cv.notify()
+            if len(self._queue) == 1:
+                self._cv.notify()
         return True
 
     def truncate_write(self, uid: str, idx: int) -> bool:
@@ -207,7 +240,8 @@ class Wal:
             if self._closed or self._failed:
                 return False
             self._queue.append(("t", uid, idx, 0, b"", 0))
-            self._cv.notify()
+            if len(self._queue) == 1:
+                self._cv.notify()
         return True
 
     def last_writer_seq(self, uid: str) -> Optional[int]:
@@ -224,7 +258,11 @@ class Wal:
                 return
             t0 = time.perf_counter_ns()
             self._write_batch(batch)
-            self._h_batch.record(time.perf_counter_ns() - t0)
+            dt = time.perf_counter_ns() - t0
+            self._h_batch.record(dt)
+            if self._trace.enabled:
+                self._trace.span("wal_batch", f"wal:{self._scope}", t0, dt,
+                                 cat="wal")
 
     def close(self) -> None:
         with self._cv:
@@ -237,6 +275,10 @@ class Wal:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+        # unregister OUR counter vector only (a restart may have
+        # registered a successor under the same scope already)
+        if ra_counters.fetch(("wal", self._scope)) is self.counter:
+            ra_counters.delete(("wal", self._scope))
 
     # ------------------------------------------------------------------
     # writer loop
@@ -259,9 +301,14 @@ class Wal:
                 batch = self._take_batch_locked()
             if batch:
                 try:
+                    batch = self._coalesce(batch)
                     t0 = time.perf_counter_ns()
                     self._write_batch(batch)
-                    self._h_batch.record(time.perf_counter_ns() - t0)
+                    dt = time.perf_counter_ns() - t0
+                    self._h_batch.record(dt)
+                    if self._trace.enabled:
+                        self._trace.span("wal_batch", f"wal:{self._scope}",
+                                         t0, dt, cat="wal")
                 except Exception as exc:  # noqa: BLE001
                     # any unexpected error is a failure episode, same as
                     # a file I/O error: the batch is unacked (servers
@@ -275,6 +322,68 @@ class Wal:
         batch = []
         while self._queue and len(batch) < self.max_batch_size:
             batch.append(self._queue.popleft())
+        return batch
+
+    def _coalesce(self, batch: List[Tuple]) -> List[Tuple]:
+        """Adaptive group commit: hold a small batch open for up to
+        ``group_commit_max_delay_s`` while a burst is still arriving,
+        so the whole burst rides one write+fsync instead of several.
+
+        Policy (docs/INTERNALS.md §15):
+        - the smoothed arrival rate must predict >= ``group_commit_min_
+          gain`` further entries inside the delay bound, or the batch
+          flushes immediately — an unloaded write never waits;
+        - a batch already at half ``max_batch_size`` flushes now;
+        - within the wait, the batch extends every time new items land
+          and flushes the moment a wait interval brings nothing (the
+          burst drained) or the deadline/batch cap is hit.
+
+        Threaded writer loop only — ``flush()`` (tests, shutdown) stays
+        deterministic and never waits."""
+        d = self.group_commit_max_delay_s
+        # update the arrival-rate estimate on every flush (batch items
+        # per elapsed wall time since the previous flush decision)
+        now = time.monotonic()
+        # window floor: a lone write moments after the previous flush
+        # decision must not read as a high-rate burst — rate is "items
+        # per recent 25ms+ window", so only sustained arrival streams
+        # clear the coalescing gate
+        rate = self._gc_rate.sample(len(batch), max(now - self._gc_t, 0.025))
+        self._gc_t = now
+        if (
+            d <= 0
+            or len(batch) >= self.max_batch_size // 2
+            or rate * d < self.group_commit_min_gain
+        ):
+            self.counter.put("group_commit_delay_us", 0)
+            return batch
+        t0 = time.perf_counter_ns()
+        deadline = t0 + int(d * 1e9)
+        tick = d / 4
+        while True:
+            with self._cv:
+                if self._closed:
+                    break
+                if not self._queue:
+                    self._cv.wait(timeout=tick)
+                got = len(self._queue)
+                while self._queue and len(batch) < self.max_batch_size:
+                    batch.append(self._queue.popleft())
+            if (
+                got == 0  # a whole interval brought nothing: burst over
+                or len(batch) >= self.max_batch_size
+                or time.perf_counter_ns() >= deadline
+            ):
+                break
+        dt = time.perf_counter_ns() - t0
+        self._h_flush_wait.record(dt)
+        self.counter.incr("group_commit_waits")
+        self.counter.put("group_commit_delay_us", dt // 1000)
+        # the wait itself feeds the estimate too (long quiet waits decay
+        # the rate so the NEXT lone write flushes immediately)
+        now = time.monotonic()
+        self._gc_rate.sample(0, now - self._gc_t)
+        self._gc_t = now
         return batch
 
     def _write_batch(self, batch: List[Tuple]) -> None:
@@ -435,17 +544,55 @@ class Wal:
                 flush_uid(uid, info)
 
         if records:
-            buf = self._frame(records)
             err = None
-            with self._io_lock:
-                if self._failed:
-                    return  # failed window: batch is unacked, drop it
-                try:
-                    faults.checked_write("wal.write", self._file, buf,
-                                         self.fault_scope)
-                    self._sync()
-                except (OSError, ValueError) as exc:
-                    err = exc
+            n_bytes = None
+            # native hot path: ONE call frames + writes + fsyncs the
+            # whole batch (no Python-side byte assembly or copy). Any
+            # armed write/fsync failpoint routes through the Python
+            # path so injection semantics stay byte-exact with tests —
+            # as does an instance-level ``_sync`` override (the WAL-
+            # death injection seam tests/self-healing rely on).
+            if (
+                self._native
+                and "_sync" not in self.__dict__
+                and not faults.any_armed("wal.write", "wal.fsync")
+            ):
+                from ra_tpu import native
+
+                with self._io_lock:
+                    if self._failed:
+                        return  # failed window: batch unacked, drop it
+                    try:
+                        self._file.flush()
+                        got = native.write_batch(
+                            records, self._file.fileno(), self.sync_method,
+                            compute_crc=self.compute_checksums,
+                        )
+                    except (OSError, ValueError) as exc:
+                        err = exc
+                        got = None
+                if err is None:
+                    if got is None:
+                        self._native = False  # lib lost/format miss: fall back
+                    else:
+                        n_bytes, fsync_ns = got
+                        self.counter.incr("native_batches")
+                        if self.sync_method in ("datasync", "sync"):
+                            self.counter.incr("fsyncs")
+                            self.counter.incr("fsync_time_us", fsync_ns // 1000)
+                            self._h_fsync.record(fsync_ns)
+            if err is None and n_bytes is None:
+                buf = self._frame(records)
+                n_bytes = len(buf)
+                with self._io_lock:
+                    if self._failed:
+                        return  # failed window: batch is unacked, drop it
+                    try:
+                        faults.checked_write("wal.write", self._file, buf,
+                                             self.fault_scope)
+                        self._sync()
+                    except (OSError, ValueError) as exc:
+                        err = exc
             if err is not None:
                 # the whole batch is unacked (no written events fire) —
                 # entries survive in memtables; servers hold/resend once
@@ -461,9 +608,9 @@ class Wal:
             # expanded log entries actually framed (runs widened)
             self.counter.incr("writes", len(batch))
             self.counter.incr("entries", n_entries)
-            self.counter.incr("bytes_written", len(buf))
+            self.counter.incr("bytes_written", n_bytes)
             self.counter.put("batch_size", len(batch))
-            self._bytes += len(buf)
+            self._bytes += n_bytes
         if self.notify_many is not None and len(written) > 1:
             # one transport/lock round for the whole batch's written
             # events (a 10k-group batch otherwise pays 10k lock rounds)
@@ -529,7 +676,13 @@ class Wal:
                 buf += _UID_HDR.pack(K_UID, ref, len(payload))
                 buf += payload
             elif kind == K_TRUNC:
-                buf += _TRUNC_HDR.pack(K_TRUNC, ref, rec[2])
+                # unpack the record's OWN ref: reusing the previous
+                # iteration's ref bound a truncate marker to whatever
+                # writer happened to precede it in the batch — recovery
+                # would truncate the wrong log (caught by the native/
+                # Python byte-parity test; the native framer was right)
+                _, ref, idx, _term, _payload = rec
+                buf += _TRUNC_HDR.pack(K_TRUNC, ref, idx)
             elif kind == K_RUN:
                 # expand to per-entry frames (disk format is unchanged)
                 _, ref, first, terms, payloads = rec

@@ -53,6 +53,8 @@ def _load():
             lib = ctypes.CDLL(so)
         except OSError:
             return None
+        if not hasattr(lib, "wal_write_batch"):
+            return None  # stale cached .so predating the write path
         lib.wal_frame_batch.restype = ctypes.c_long
         lib.wal_frame_batch.argtypes = [
             ctypes.c_char_p,  # kinds u8*
@@ -71,6 +73,21 @@ def _load():
         lib.wal_frame_bound.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long]
         lib.wal_crc32.restype = ctypes.c_uint32
         lib.wal_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.wal_write_batch.restype = ctypes.c_long
+        lib.wal_write_batch.argtypes = [
+            ctypes.c_char_p,  # kinds u8*
+            ctypes.c_void_p,  # refs u16*
+            ctypes.c_void_p,  # idxs u64*
+            ctypes.c_void_p,  # terms u64*
+            ctypes.c_void_p,  # offs u64*
+            ctypes.c_void_p,  # lens u32*
+            ctypes.c_long,
+            ctypes.c_char_p,  # blob
+            ctypes.c_int,     # compute_crc
+            ctypes.c_int,     # fd
+            ctypes.c_int,     # sync_mode
+            ctypes.c_void_p,  # fsync_ns out
+        ]
         _lib = lib
         return _lib
 
@@ -87,11 +104,9 @@ K_RUN = 100
 _K_ENTRY = 2
 
 
-def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[bytes]:
-    """Frame a WAL batch natively; None when the native lib is absent."""
-    lib = _load()
-    if lib is None or not records:
-        return None if lib is None else b""
+def _pack_arrays(records: List[Record]):
+    """Expand records (runs widened) into the parallel column arrays +
+    payload blob the native entry points consume."""
     n = 0
     for r in records:
         n += len(r[4]) if r[0] == K_RUN else 1
@@ -130,7 +145,15 @@ def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[byt
     if n:
         offs[0] = 0
         np.cumsum(lens[:-1], dtype=np.uint64, out=offs[1:])
-    blob = b"".join(parts)
+    return n, kinds, refs, idxs, terms, offs, lens, b"".join(parts)
+
+
+def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[bytes]:
+    """Frame a WAL batch natively; None when the native lib is absent."""
+    lib = _load()
+    if lib is None or not records:
+        return None if lib is None else b""
+    n, kinds, refs, idxs, terms, offs, lens, blob = _pack_arrays(records)
     bound = lib.wal_frame_bound(
         kinds.ctypes.data_as(ctypes.c_char_p), lens.ctypes.data, n
     )
@@ -151,6 +174,50 @@ def frame_batch(records: List[Record], compute_crc: bool = True) -> Optional[byt
     if w < 0:
         return None
     return out.raw[:w]
+
+
+_SYNC_MODES = {"none": 0, "datasync": 1, "sync": 2}
+
+
+def write_batch(
+    records: List[Record], fd: int, sync_method: str,
+    compute_crc: bool = True,
+) -> Optional[Tuple[int, int]]:
+    """Frame + write + fsync a whole WAL batch natively against ``fd``
+    (one call, no Python-side byte assembly; the GIL is released for
+    the duration). Returns ``(bytes_written, fsync_wait_ns)``; None
+    when the native lib is absent, the batch is malformed, or the sync
+    method is unknown (callers fall back to the Python path). Raises
+    OSError (errno preserved) on write/fsync failure — fsync failure
+    poisons the file exactly as the Python path's rule demands."""
+    lib = _load()
+    mode = _SYNC_MODES.get(sync_method)
+    if lib is None or mode is None:
+        return None
+    if not records:
+        return (0, 0)
+    n, kinds, refs, idxs, terms, offs, lens, blob = _pack_arrays(records)
+    fsync_ns = ctypes.c_longlong(0)
+    w = lib.wal_write_batch(
+        kinds.ctypes.data_as(ctypes.c_char_p),
+        refs.ctypes.data,
+        idxs.ctypes.data,
+        terms.ctypes.data,
+        offs.ctypes.data,
+        lens.ctypes.data,
+        n,
+        blob,
+        1 if compute_crc else 0,
+        fd,
+        mode,
+        ctypes.byref(fsync_ns),
+    )
+    if w <= -1000:
+        err = -(w + 1000)
+        raise OSError(err, os.strerror(err))
+    if w < 0:
+        return None
+    return int(w), int(fsync_ns.value)
 
 
 def crc32(data: bytes) -> Optional[int]:

@@ -18,8 +18,12 @@
 // (no external deps; CRC32 implemented here, polynomial 0xEDB88320,
 // matching zlib.crc32).
 
+#include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
+#include <unistd.h>
 
 static uint32_t crc_table[256];
 static bool crc_ready = false;
@@ -121,6 +125,64 @@ long wal_frame_bound(const uint8_t* kinds, const uint32_t* lens, long n) {
         else total += 11;
     }
     return total;
+}
+
+// Frame + write + fsync a whole batch against `fd` in ONE call — the
+// serialize/write/fsync hot path of the shared WAL without any
+// Python-side byte assembly (and without the GIL for the duration:
+// ctypes releases it around the call).
+//
+// sync_mode: 0 = none, 1 = fdatasync, 2 = fsync. The fsync wait in
+// nanoseconds (CLOCK_MONOTONIC) is stored to *fsync_ns when syncing.
+// Returns bytes written; -1 on a malformed batch (caller falls back to
+// the Python framer); -(1000+errno) on an I/O failure (write short/
+// failed or fsync failed — the caller must treat the file as poisoned,
+// same as the Python path's fsync-failure rule).
+long wal_write_batch(
+    const uint8_t* kinds,
+    const uint16_t* refs,
+    const uint64_t* idxs,
+    const uint64_t* terms,
+    const uint64_t* offs,
+    const uint32_t* lens,
+    long n,
+    const uint8_t* blob,
+    int compute_crc,
+    int fd,
+    int sync_mode,
+    long long* fsync_ns
+) {
+    long bound = wal_frame_bound(kinds, lens, n);
+    uint8_t* buf = (uint8_t*)malloc(bound > 0 ? bound : 1);
+    if (!buf) return -(1000 + ENOMEM);
+    long w = wal_frame_batch(kinds, refs, idxs, terms, offs, lens, n,
+                             blob, compute_crc, buf, bound);
+    if (w < 0) { free(buf); return -1; }
+    long off = 0;
+    while (off < w) {
+        ssize_t got = write(fd, buf + off, (size_t)(w - off));
+        if (got < 0) {
+            if (errno == EINTR) continue;
+            int e = errno;
+            free(buf);
+            return -(1000 + e);
+        }
+        off += got;
+    }
+    free(buf);
+    if (sync_mode != 0) {
+        struct timespec t0, t1;
+        clock_gettime(CLOCK_MONOTONIC, &t0);
+        int rc = (sync_mode == 1) ? fdatasync(fd) : fsync(fd);
+        clock_gettime(CLOCK_MONOTONIC, &t1);
+        if (rc != 0) return -(1000 + errno);
+        if (fsync_ns)
+            *fsync_ns = (long long)(t1.tv_sec - t0.tv_sec) * 1000000000LL
+                        + (t1.tv_nsec - t0.tv_nsec);
+    } else if (fsync_ns) {
+        *fsync_ns = 0;
+    }
+    return w;
 }
 
 uint32_t wal_crc32(const uint8_t* buf, uint64_t len) {

@@ -626,6 +626,95 @@ consensus_step_packed_sub = jax.jit(
 )
 
 
+# Scatter-fused packed interface (docs/INTERNALS.md §15): the host's
+# queued log-tail updates ride the SAME packed array as the mailbox —
+# six extra rows after MBOX_FIELDS — and are applied on-device at the
+# START of the step, before the quorum scan. One transfer and one
+# dispatch per step instead of separate record_appended_runs /
+# record_written calls (each with its own column uploads): on a CPU
+# host the per-call dispatch overhead was a top cost of the unloaded
+# commit wave. Pad entries carry an out-of-range gid (>= capacity);
+# scatters drop them. a_* rows are contiguous same-term appended runs
+# (one per group, gids unique); w_* rows are durable watermarks.
+# NOT for sharded state: the mailbox shards column-wise, which would
+# split the scatter rows across devices — sharded coordinators keep
+# the separate record_* calls.
+MBOX_SCAT_FIELDS = ["a_gid", "a_lo", "a_hi", "a_term", "w_gid", "w_idx"]
+
+
+def _apply_packed_scatters(state: GroupState, packed: jax.Array) -> GroupState:
+    # row-space form of record_appended_runs + record_written: every
+    # temporary is (rows, k)-shaped, never (G, ...)-shaped, so the
+    # per-step cost scales with the mailbox width, not capacity (the
+    # full-state jnp.where variant cost O(G*k) per step at 10k groups).
+    # Semantics match record_appended_runs exactly: tails advance by
+    # max, ring slots in [lo, hi] take the run term, last_term re-reads
+    # the updated ring at the (possibly unmoved) tail, staleness
+    # clears; pad rows (gid >= G) drop on every scatter.
+    base = len(MBOX_FIELDS)
+    gids = packed[base]
+    los = packed[base + 1]
+    his = packed[base + 2]
+    terms = packed[base + 3]
+    k = state.term_suffix.shape[-1]
+    los_c = jnp.maximum(los, his - (k - 1))
+    slots = jnp.arange(k)[None, :]
+    # largest index i <= hi with i % k == slot
+    idx_at_slot = his[:, None] - ((his[:, None] - slots) % k)
+    mask = idx_at_slot >= los_c[:, None]
+    cur = state.term_suffix.at[gids].get(mode="fill", fill_value=0)
+    rows = jnp.where(mask, terms[:, None], cur)
+    ts = state.term_suffix.at[gids].set(rows, mode="drop")
+    old_last = state.last_index.at[gids].get(mode="fill", fill_value=0)
+    new_last = jnp.maximum(old_last, his)
+    last_index = state.last_index.at[gids].set(new_last, mode="drop")
+    ring_at_tail = jnp.take_along_axis(
+        rows, (new_last % k)[:, None], axis=-1
+    ).squeeze(-1)
+    last_term = state.last_term.at[gids].set(ring_at_tail, mode="drop")
+    unknown_lo = state.unknown_lo.at[gids].set(
+        jnp.ones_like(gids), mode="drop"
+    )
+    unknown_hi = state.unknown_hi.at[gids].set(
+        jnp.zeros_like(gids), mode="drop"
+    )
+    return state._replace(
+        term_suffix=ts,
+        last_index=last_index,
+        last_term=last_term,
+        unknown_lo=unknown_lo,
+        unknown_hi=unknown_hi,
+        written_index=state.written_index.at[packed[base + 4]].max(
+            packed[base + 5], mode="drop"
+        ),
+    )
+
+
+def _consensus_step_packed_scat_impl(state: GroupState, packed: jax.Array):
+    state = _apply_packed_scatters(state, packed)
+    return _consensus_step_packed_impl(state, packed)
+
+
+consensus_step_packed_scat = jax.jit(
+    _consensus_step_packed_scat_impl, donate_argnums=(0,)
+)
+
+
+def _consensus_step_packed_sub_scat_impl(
+    state: GroupState, packed: jax.Array, gidx: jax.Array
+):
+    # scatters apply to the FULL state before the active-set gather
+    # (every appended/written group is in the active set by
+    # construction, so the gathered sub-batch sees the new tails)
+    state = _apply_packed_scatters(state, packed)
+    return _consensus_step_packed_sub_impl(state, packed, gidx)
+
+
+consensus_step_packed_sub_scat = jax.jit(
+    _consensus_step_packed_sub_scat_impl, donate_argnums=(0,)
+)
+
+
 # ---------------------------------------------------------------------------
 # host-side helpers for log-tail maintenance
 

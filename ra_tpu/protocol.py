@@ -112,6 +112,16 @@ def sanitize_for_wire(msg: Any) -> Any:
     return msg
 
 
+# encode memo for the fan-out hot shape: ONE Command object rides to
+# thousands of groups (the pipelined wave), and every group's log would
+# re-pickle it. Keyed by id() and validated by identity — safe because
+# the memo holds a strong reference, so a live entry's id cannot be
+# reused by another object. Bounded FIFO; commands are immutable once
+# submitted (NamedTuple), which is what makes the cache sound.
+_ENC_MEMO: dict = {}
+_ENC_ORDER: list = []
+
+
 def encode_cmd(cmd: Any) -> bytes:
     """Serialize a log command for durable storage. Client reply handles
     (``from_ref``) are process-ephemeral — replies are never re-issued
@@ -122,10 +132,28 @@ def encode_cmd(cmd: Any) -> bytes:
     disk regardless of when they were submitted."""
     import pickle
 
-    if isinstance(cmd, Command) and (
-        cmd.from_ref is not None or cmd.ts is not None
-    ):
-        cmd = cmd._replace(from_ref=None, ts=None)
+    if isinstance(cmd, Command):
+        if cmd.from_ref is not None or cmd.ts is not None:
+            # never memoize stamped/reply-carrying commands: the memo
+            # holds its key object strongly (that is what makes id()
+            # keying sound), and pinning retired reply handles would
+            # extend "process-ephemeral" arbitrarily. The fan-out hot
+            # shape this cache exists for is a bare noreply Command;
+            # per-run dedup of stamped ones is Log._bulk_insert's memo.
+            return pickle.dumps(cmd._replace(from_ref=None, ts=None))
+        key = id(cmd)
+        hit = _ENC_MEMO.get(key)
+        if hit is not None and hit[0] is cmd:
+            return hit[1]
+        out = pickle.dumps(cmd)
+        _ENC_MEMO[key] = (cmd, out)
+        _ENC_ORDER.append(key)
+        if len(_ENC_ORDER) > 128:
+            try:
+                _ENC_MEMO.pop(_ENC_ORDER.pop(0), None)
+            except IndexError:
+                pass  # concurrent eviction: bound is approximate
+        return out
     return pickle.dumps(cmd)
 
 
@@ -268,7 +296,13 @@ class InfoReply:
 
 @dataclasses.dataclass(frozen=True)
 class ElectionTimeout:
-    pass
+    # detector-fired timeouts stamp the monotonic time the suspicion
+    # was CONFIRMED; the handler drops the trigger when the group has
+    # seen contact (or restarted its election window) since — a delayed
+    # delivery (e.g. behind a long jit compile in the pipelined loop)
+    # must not act on a stale observation and depose a fresh leader.
+    # 0.0 (explicit operator/test triggers) always acts.
+    armed_at: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

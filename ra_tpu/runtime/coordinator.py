@@ -257,6 +257,7 @@ class BatchCoordinator:
         max_pipeline_count: int = 4096,
         max_command_backlog: int = 4096,
         command_deadline_s: float = 5.0,
+        pipeline: bool = True,
     ):
         self.name = node_name
         self.capacity = capacity
@@ -373,14 +374,14 @@ class BatchCoordinator:
         self._pending_roles: List[Tuple[int, int]] = []
         self._hot: set = set()  # gids with queued inbox msgs / term hints
         self._applied_np = np.zeros(capacity, np.int64)  # last_applied mirror
-        # reusable mailbox pack buffer. Safe to mutate between steps:
-        # every step synchronizes on its egress (np.asarray) before the
-        # next build, so a zero-copy jnp view is never read after that.
-        # _mbox_in_flight enforces that invariant in code: set when a
-        # build hands out a view, cleared only after the step's egress
-        # sync — a second in-flight build is a bug, not silent corruption
-        self._mbox_buf: Optional[np.ndarray] = None
-        self._mbox_in_flight = False
+        # mailbox pack buffers, double-buffered (docs/INTERNALS.md §15):
+        # a build hands out a zero-copy jnp view of one buffer; the
+        # buffer returns to the pool only after that step's egress sync
+        # (np.asarray) proves the device consumed the view. The
+        # sequential loop cycles one buffer; the pipelined loop keeps
+        # one in flight while the next step packs the other — the pool
+        # is bounded by the single-outstanding-ticket cap.
+        self._mbox_pool: List[np.ndarray] = []
         # guards self.state (donated buffers!) between the step thread and
         # add_group callers
         self._state_lock = threading.Lock()
@@ -393,6 +394,34 @@ class BatchCoordinator:
         self.sub_steps = 0  # steps taken on the active-set (sub) path
         self.msgs_processed = 0
 
+        # pipelined wave loop (docs/INTERNALS.md §15): the threaded run
+        # loop splits each step into host staging (ingress drain + pack
+        # + device dispatch, step thread) and realisation (egress sync
+        # + process + AER fan-out, egress thread), overlapping step
+        # N+1's staging with step N's device compute / egress sync.
+        # ``step_once`` (tests, cooperative bench driver) is always the
+        # sequential two-halves-inline form; callers must not mix it
+        # with a STARTED pipelined loop (ticket order would invert).
+        self.pipeline = pipeline
+        self._pipe_cv = threading.Condition()
+        self._pipe_q: deque = deque()
+        self._pipe_inflight = 0  # tickets dispatched but not finished
+        self._egress_thread: Optional[threading.Thread] = None
+        # lost-wakeup guard for the pipelined idle wait: realisation
+        # and the decoupled durable-ack path produce step work (_hot,
+        # pending scatters) under the STATE lock, so the idle predicate
+        # below can read stale emptiness; the flag is set under the
+        # ingress cv right before their notify and consumed by the
+        # step thread, making every produced-work notify land
+        self._step_wake = False
+        # work drained by ingest-only passes (a ticket still in
+        # flight): rares and AER-dirty gids park here until the next
+        # dispatching pass picks them up (appended/written runs go
+        # straight to _pending_scatters, their canonical deferred form)
+        self._pending_rare: List[Tuple] = []
+        self._pending_aer: set = set()
+        # outstanding ticket of the cooperative pipelined driver form
+        self._coop_ticket: Optional[BatchCoordinator._StepTicket] = None
         self._step_thread = threading.Thread(
             target=self._run, name=f"ra-batch-{node_name}", daemon=True
         )
@@ -462,6 +491,66 @@ class BatchCoordinator:
                     q.append(cmd)
             self._ingress_cv.notify()
 
+    def wal_notify(self, uid: str, evt) -> None:
+        """Log-event entry point for WAL / segment-writer notify
+        callbacks. ``written`` events take the decoupled durable-ack
+        path — handled on the CALLING (WAL writer) thread so a durable
+        batch advances watermarks, releases deferred AER acks, and
+        queues the device written-scatter without waiting for a step-
+        loop pass. Everything else rides normal ingress ordering."""
+        if type(evt) is tuple and evt and evt[0] == "written":
+            self.wal_notify_many([(uid, evt)])
+        else:
+            self.deliver((uid, self.name), ("log_event", evt), None)
+
+    def wal_notify_many(self, items) -> None:
+        """Bulk durable-watermark delivery from one WAL flush (wire as
+        ``wal.notify_many``): one state-lock round for the whole
+        batch's written events. The durable-ack decoupling invariant
+        (docs/INTERNALS.md §15): everything this touches — the log's
+        written watermark, ``pending_ack``, ``last_ok_sent``, the
+        pending-scatter queue — is guarded by the state lock, and the
+        ack it emits is exactly the ack the step-loop path would have
+        emitted one wave later."""
+        route_out: Dict[str, List] = {}
+        pend: List[Tuple] = []
+        with self._state_lock:
+            by_get = self.by_name.get
+            for uid, evt in items:
+                g = by_get(uid)
+                if g is None:
+                    continue
+                if not (type(evt) is tuple and evt and evt[0] == "written"):
+                    self.deliver((uid, self.name), ("log_event", evt), None)
+                    continue
+                g.log.handle_event(evt)
+                wi, wt = g.log.last_written()
+                pend.append(("w", g.gid, wi))
+                if g.pending_ack is not None and wi >= g.pending_ack[1]:
+                    leader_sid, cover = g.pending_ack
+                    g.pending_ack = None
+                    ack = min(wi, cover)
+                    at = g.log.fetch_term(ack)
+                    out = route_out.get(leader_sid[1])
+                    if out is None:
+                        route_out[leader_sid[1]] = out = []
+                    out.append(
+                        (leader_sid,
+                         AppendEntriesReply(g.term, True, ack + 1, ack,
+                                            at if at is not None else wt),
+                         (g.name, self.name))
+                    )
+            if pend:
+                # the device learns the durable watermark at the next
+                # dispatch (the written scatter drives the quorum scan)
+                self._pending_scatters.extend(pend)
+        for node_name, msgs in route_out.items():
+            self._send_batch(node_name, msgs)
+        if pend:
+            with self._ingress_cv:
+                self._step_wake = True
+                self._ingress_cv.notify()
+
     def deliver_many(self, msgs) -> None:
         """Batch ingress: one lock round for many ``(to_sid, msg,
         from_sid)`` triples (unknown group names are dropped, as in
@@ -503,7 +592,15 @@ class BatchCoordinator:
     def stop(self) -> None:
         self.running = False
         if self._started:
+            with self._pipe_cv:
+                self._pipe_cv.notify_all()
             self._step_thread.join(timeout=5)
+            if self._egress_thread is not None:
+                self._egress_thread.join(timeout=5)
+            # join the detector too: a straggling health scan sitting in
+            # a device fetch at interpreter exit can crash the XLA
+            # runtime's C++ teardown
+            self._detector.join(timeout=5)
         from ra_tpu import counters as _counters
         from ra_tpu import health as _health
 
@@ -662,6 +759,9 @@ class BatchCoordinator:
     # -- the step loop -----------------------------------------------------
 
     def _run(self) -> None:
+        if self.pipeline:
+            self._run_pipelined()
+            return
         while self.running:
             worked = self.step_once()
             if not worked:
@@ -669,14 +769,223 @@ class BatchCoordinator:
                     if not (self._ingress or self._cmd_q or self._low_dirty):
                         self._ingress_cv.wait(timeout=0.05)
 
-    def step_once(self) -> bool:
-        """One coordinator iteration: drain ingress, scatter host log
-        updates, run the fused device step, realise egress. Returns
-        False when there was nothing to do."""
-        with self._state_lock:
-            return self._step_once_locked()
+    def _run_pipelined(self) -> None:
+        """Two-stage pipelined wave loop (docs/INTERNALS.md §15). This
+        thread owns host STAGING: ingress drain, command append + WAL
+        handoff, queued scatters, mailbox pack, async device dispatch.
+        The egress thread owns step REALISATION: egress host sync,
+        egress processing (applies, acks, role changes), rare messages,
+        AER fan-out. Every touch of host group state happens under
+        ``_state_lock`` on either thread; the overlap window is the
+        device compute + egress sync wait, which runs with no lock
+        held — step N+1 stages and dispatches inside it. At most ONE
+        ticket is in flight past the one being realised (the double
+        buffer bound); tickets are realised strictly in dispatch order
+        (egress fields are absolute per-step snapshots — out-of-order
+        realisation would regress role/term mirrors)."""
+        self._egress_thread = threading.Thread(
+            target=self._egress_loop, name=f"ra-batch-eg-{self.name}",
+            daemon=True,
+        )
+        self._egress_thread.start()
+        cv = self._pipe_cv
+        while self.running:
+            t0 = time.perf_counter_ns()
+            # dispatch only with NO ticket in flight (the double-buffer
+            # bound): while one is being realised, passes are INGEST-
+            # ONLY — ingress keeps draining and commands keep reaching
+            # the logs/WAL (coalescing the next step) without splitting
+            # the wave into many small device steps. _pipe_inflight is
+            # only incremented by this thread, so a lock-free read of 0
+            # is exact (a stale >0 just delays dispatch by one pass).
+            inflight = self._pipe_inflight > 0
+            with self._state_lock:
+                ticket = self._drain_and_dispatch(dispatch=not inflight)
+            if inflight:
+                # host staging done while the previous step's device
+                # compute / egress realisation / WAL handoff were in
+                # flight — the overlap the pipeline exists for
+                dt = time.perf_counter_ns() - t0
+                if dt > 20_000:  # ignore empty probe passes
+                    self.counters.incr("pipeline_overlap_ns", dt)
+            if ticket is not None:
+                self.counters.incr("pipeline_steps")
+                with cv:
+                    self._pipe_inflight += 1
+                    self._pipe_q.append(ticket)
+                    cv.notify_all()
+                continue
+            with self._ingress_cv:
+                if self._pipe_inflight > 0:
+                    # deferred device work (_hot, queued scatters) can
+                    # only be acted on by a dispatching pass — waiting
+                    # on it here would busy-spin until realisation
+                    # finishes (its _step_wake is the wake signal)
+                    if not (
+                        self._step_wake or self._ingress or self._cmd_q
+                        or self._low_dirty
+                    ):
+                        self._ingress_cv.wait(timeout=0.05)
+                elif not (
+                    self._step_wake
+                    or self._ingress or self._cmd_q or self._low_dirty
+                    or self._hot or self._pending_scatters
+                    or self._pending_roles
+                ):
+                    self._ingress_cv.wait(timeout=0.05)
+                self._step_wake = False
+        with cv:
+            cv.notify_all()
 
-    def _step_once_locked(self) -> bool:
+    def _egress_loop(self) -> None:
+        cv = self._pipe_cv
+        while True:
+            with cv:
+                while not self._pipe_q and self.running:
+                    cv.wait(timeout=0.05)
+                if not self._pipe_q:
+                    return  # stopped and drained
+                ticket = self._pipe_q.popleft()
+            eg_np = None
+            if ticket.eg_packed is not None:
+                # device sync OUTSIDE every lock: the step thread stages
+                # and dispatches the next step during this wait
+                eg_np = np.asarray(ticket.eg_packed)
+            with self._state_lock:
+                self._finish_ticket(ticket, eg_np)
+            with cv:
+                self._pipe_inflight -= 1
+                cv.notify_all()
+            # realisation may have produced device work (hot retries,
+            # queued scatters): wake the step thread if it went idle
+            with self._ingress_cv:
+                self._step_wake = True
+                self._ingress_cv.notify()
+
+    def step_once(self) -> bool:
+        """One SEQUENTIAL coordinator iteration: drain ingress, scatter
+        host log updates, run the fused device step, realise egress.
+        Returns False when there was nothing to do. Deterministic-test
+        and cooperative-driver entry point — never call it on a started
+        pipelined coordinator (realisation order would invert)."""
+        with self._state_lock:
+            prev = self._coop_ticket
+            if prev is not None:
+                # flush a leftover pipelined-driver ticket first so
+                # realisation order is preserved across driver modes
+                self._coop_ticket = None
+                eg_np = (
+                    np.asarray(prev.eg_packed)
+                    if prev.eg_packed is not None else None
+                )
+                self._finish_ticket(prev, eg_np)
+                return True
+            ticket = self._drain_and_dispatch()
+            if ticket is None:
+                return False
+            eg_np = (
+                np.asarray(ticket.eg_packed)
+                if ticket.eg_packed is not None else None
+            )
+            self._finish_ticket(ticket, eg_np)
+            return True
+
+    def step_stage(self) -> bool:
+        """Cooperative-pipeline half A: drain ingress, append commands,
+        ship drain-produced AERs, and DISPATCH the fused device step
+        (async), parking the ticket for ``step_finish``. A multi-
+        coordinator driver stages every coordinator first, then
+        finishes every coordinator — each device step then computes
+        while the driver stages the others (the single-thread form of
+        the wave pipeline, docs/INTERNALS.md §15)."""
+        with self._state_lock:
+            prev = self._coop_ticket
+            if prev is not None:
+                # driver skipped a finish: realise in order first
+                self._coop_ticket = None
+                eg_np = (
+                    np.asarray(prev.eg_packed)
+                    if prev.eg_packed is not None else None
+                )
+                self._finish_ticket(prev, eg_np)
+            ticket = self._drain_and_dispatch()
+            self._coop_ticket = ticket
+            return ticket is not None
+
+    def step_finish(self) -> bool:
+        """Cooperative-pipeline half B: realise the ticket parked by
+        ``step_stage`` (egress sync + processing + commit-driven AERs).
+        Counts the staged-while-in-flight overlap."""
+        with self._state_lock:
+            ticket = self._coop_ticket
+            if ticket is None:
+                return False
+            self._coop_ticket = None
+            t0 = time.perf_counter_ns()
+            eg_np = (
+                np.asarray(ticket.eg_packed)
+                if ticket.eg_packed is not None else None
+            )
+            self._finish_ticket(ticket, eg_np)
+            if ticket.stepped:
+                self.counters.incr("pipeline_steps")
+                # host work done between device dispatch and egress
+                # sync (AER fan-out + the other coordinators' staging):
+                # the window the device step computed inside
+                hidden = t0 - ticket.t_pack
+                if hidden > 0:
+                    self.counters.incr("pipeline_overlap_ns", hidden)
+            return True
+
+    def step_pipelined(self) -> bool:
+        """One cooperative PIPELINED iteration (single-driver-thread
+        form of the wave pipeline, docs/INTERNALS.md §15): realise the
+        PREVIOUSLY dispatched step (its device compute had the whole
+        driver round to finish), then stage + dispatch the next one —
+        whose drain already sees the realised egress's products, and
+        whose device compute overlaps this thread realising the OTHER
+        coordinators in the round-robin. Drain-produced AERs leave at
+        dispatch time (inside ``_drain_and_dispatch``), so replication
+        fan-out never waits a pipeline slot. Same ticket machinery as
+        the threaded loop; keep calling until False before reading
+        final state, and do not mix with a started loop."""
+        with self._state_lock:
+            prev = self._coop_ticket
+            self._coop_ticket = None
+            if prev is not None:
+                eg_np = (
+                    np.asarray(prev.eg_packed)
+                    if prev.eg_packed is not None else None
+                )
+                self._finish_ticket(prev, eg_np)
+            t0 = time.perf_counter_ns()
+            ticket = self._drain_and_dispatch()
+            self._coop_ticket = ticket
+            if ticket is not None and prev is not None:
+                # staged+dispatched in the same round a previous step
+                # was realised: the new device step runs while the
+                # driver services the other coordinators
+                self.counters.incr(
+                    "pipeline_overlap_ns", time.perf_counter_ns() - t0
+                )
+                self.counters.incr("pipeline_steps")
+            return ticket is not None or prev is not None
+
+    class _StepTicket:
+        """One dispatched-but-unrealised step: the device egress handle
+        plus everything realisation needs (who was consumed, the
+        position->gid map, rares, and the staging timestamps)."""
+
+        __slots__ = ("eg_packed", "consumed", "act", "aer_dirty", "rare",
+                     "mbox_buf", "t_in", "t_drain", "t_pack", "stepped")
+
+        def __init__(self, **kw):
+            for k in self.__slots__:
+                setattr(self, k, kw.get(k))
+
+    def _drain_and_dispatch(
+        self, dispatch: bool = True
+    ) -> Optional["BatchCoordinator._StepTicket"]:
         _t_in = time.perf_counter_ns()
         with self._ingress_cv:
             batch = list(self._ingress)
@@ -689,25 +998,37 @@ class BatchCoordinator:
                 # concurrent deliver would fill it and the next drain
                 # would double-process those commands
                 cmd_q = None
-        rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = []
+        # seed rares / AER-dirty gids parked by earlier ingest-only
+        # passes (pipelined loop); appended/written runs they drained
+        # are already in _pending_scatters
+        # ALWAYS detach (same trap as cmd_q above): _route_one appends
+        # into these, so keeping an alias of the live (empty) container
+        # would re-seed — and re-process — this pass's rares/AER gids
+        # on the next pass
+        rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = (
+            self._pending_rare
+        )
+        self._pending_rare = []
+        aer_dirty: set = self._pending_aer
+        self._pending_aer = set()
         # appended runs: gid -> [[lo, hi, term], ...] (contiguous,
         # same-term); written: gid -> max durable idx. Run-based so the
         # device scatter is one row per touched GROUP, not per entry.
         appended: Dict[int, List[List[int]]] = {}
         written: Dict[int, int] = {}
-        aer_dirty: set = set()
         # replies produced during routing (deferred durable acks): one
         # transport hop per destination per step, not one per group
         route_out: Dict[str, List] = {}
 
         by_get = self.by_name.get
         route = self._route_one
+        now_mono = time.monotonic() if batch else 0.0
         for to_name, from_sid, msg in batch:
             g = by_get(to_name)
             if g is None:
                 continue
             route(g, from_sid, msg, rare, appended, written, aer_dirty,
-                  route_out)
+                  route_out, now_mono)
         if route_out:
             for node_name, msgs in route_out.items():
                 self._send_batch(node_name, msgs)
@@ -720,13 +1041,38 @@ class BatchCoordinator:
         if self._low_dirty:
             self._drain_low_lane(appended, written, aer_dirty)
 
+        if not dispatch:
+            # ingest-only pass (a ticket is still being realised): fold
+            # everything drained into the pending state the next
+            # dispatching pass picks up. Commands have already reached
+            # the logs and the WAL queue — the coalescing the pipeline
+            # is for happens here.
+            if appended or written:
+                pend = self._pending_scatters
+                for gid, runs in appended.items():
+                    for lo, hi, term in runs:
+                        pend.append(("a", gid, lo, hi, term))
+                for gid, idx in written.items():
+                    pend.append(("w", gid, idx))
+            if rare:
+                self._pending_rare = rare
+            if aer_dirty:
+                # replication fan-out never waits for the next dispatch:
+                # fresh appends ship while the in-flight step realises
+                self._send_aers(aer_dirty)
+            if batch or cmd_q:
+                _t_drain = time.perf_counter_ns()
+                self._wave_h["ingress_drain"].record(_t_drain - _t_in)
+                if self._trace.enabled:
+                    self._trace.span("ingress_drain", self.name, _t_in,
+                                     _t_drain - _t_in)
+            return None
         if not (
             batch or cmd_q or self._hot or rare or appended or written
             or self._pending_scatters or self._pending_roles
         ):
-            return False
+            return None
         _t_drain = time.perf_counter_ns()
-        _t_pack = _t_dev = None
 
         if self._pending_roles:
             gids, roles, _ = self._pad3(
@@ -749,82 +1095,142 @@ class BatchCoordinator:
                     written[gid] = idx
         self._pending_scatters = []
 
+        app_rows: List[Tuple[int, int, int, int]] = []
         if appended:
-            rows: List[Tuple[int, int, int, int]] = []
             legacy: List[Tuple[int, int, int]] = []  # older runs, per entry
             for gid, runs in appended.items():
                 for lo, hi, term in runs[:-1]:
                     legacy.extend((gid, i, term) for i in range(lo, hi + 1))
                 lo, hi, term = runs[-1]
-                rows.append((gid, lo, hi, term))
+                app_rows.append((gid, lo, hi, term))
             if legacy:
                 # rare (mixed-term batches): scatter older runs first so
                 # the newest run's ring slots win
                 gids, idxs, terms = self._pad3(legacy)
                 self.state = C.record_appended(self.state, gids, idxs, terms)
-            gids, los, his, terms = self._pad4(rows)
-            self.state = C.record_appended_runs(self.state, gids, los, his, terms)
-        if written:
-            if self._lat_gids:
-                now_w = time.monotonic_ns()
-                for gid_w in self._lat_gids:
-                    idx_w = written.get(gid_w)
-                    gw = self.groups[gid_w] if idx_w is not None else None
-                    if gw is None:
-                        continue
-                    lat = gw.lat
-                    if lat is not None and lat[3] == 0 and idx_w >= lat[0]:
-                        lat[3] = now_w
-                        self._commit_h["append_durable"].record(now_w - lat[2])
-            gids, idxs, _ = self._pad3([(g, i, 0) for g, i in written.items()])
-            self.state = C.record_written(self.state, gids, idxs)
+        if written and self._lat_gids:
+            now_w = time.monotonic_ns()
+            for gid_w in self._lat_gids:
+                idx_w = written.get(gid_w)
+                gw = self.groups[gid_w] if idx_w is not None else None
+                if gw is None:
+                    continue
+                lat = gw.lat
+                if lat is not None and lat[3] == 0 and idx_w >= lat[0]:
+                    lat[3] = now_w
+                    self._commit_h["append_durable"].record(now_w - lat[2])
 
         # activity-scaled path selection: groups with device-relevant
         # work this step are exactly the hot set (queued messages/term
         # hints) plus those whose log tail or durable watermark moved
         # (the quorum scan can advance their commit). Everything else
         # is provably unchanged by an empty-mailbox step.
+        # The newest appended runs and the durable watermarks ride the
+        # packed mailbox itself (C.MBOX_SCAT_FIELDS rows) and apply
+        # inside the fused step — one transfer + one dispatch per step.
         act: Optional[list] = None
         if self._shard_state is None and self.active_set != "never":
             cand = self._hot | appended.keys() | written.keys()
             if self.active_set == "always" or len(cand) <= (self.capacity >> 2):
                 act = sorted(cand)
+        eg_packed = consumed = act_np = mbox_buf = None
+        stepped = False
         if act is not None:
             if act:
-                packed, gidx, act_np, consumed = self._build_mailbox_sub(act)
-                _t_pack = time.perf_counter_ns()
-                self.state, eg_packed = C.consensus_step_packed_sub(
+                packed, gidx, act_np, consumed, mbox_buf = (
+                    self._build_mailbox_sub(act, app_rows, written)
+                )
+                self.state, eg_packed = C.consensus_step_packed_sub_scat(
                     self.state, packed, gidx
                 )
-                eg_np = np.asarray(eg_packed)
-                _t_dev = time.perf_counter_ns()
-                eg = {
-                    name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)
-                }
+                stepped = True
                 self.steps += 1
                 self.sub_steps += 1
                 self.msgs_processed += len(consumed)
-                self._process_egress(eg, consumed, aer_dirty, act=act_np)
         else:
-            packed, consumed = self._build_mailbox()
-            if self._shard_state is not None:
+            shard = self._shard_state is not None
+            if shard:
+                # sharded state: the mailbox shards column-wise, which
+                # would split scatter rows across devices — apply the
+                # log-tail scatters as separate (replicated-index) calls
+                if app_rows:
+                    gids, los, his, terms = self._pad4(app_rows)
+                    self.state = C.record_appended_runs(
+                        self.state, gids, los, his, terms
+                    )
+                if written:
+                    gids, idxs, _ = self._pad3(
+                        [(g, i, 0) for g, i in written.items()]
+                    )
+                    self.state = C.record_written(self.state, gids, idxs)
+                packed, consumed, mbox_buf = self._build_mailbox(None, None)
                 # re-pin before the fused step so it executes SPMD over
                 # the mesh (no-op when the layout is already right)
                 self.state = jax.device_put(self.state, self._shard_state)
                 packed = jax.device_put(packed, self._shard_mbox)
-            _t_pack = time.perf_counter_ns()
-            self.state, eg_packed = C.consensus_step_packed(self.state, packed)
-            eg_np = np.asarray(eg_packed)
+                self.state, eg_packed = C.consensus_step_packed(
+                    self.state, packed
+                )
+            else:
+                packed, consumed, mbox_buf = self._build_mailbox(
+                    app_rows, written
+                )
+                self.state, eg_packed = C.consensus_step_packed_scat(
+                    self.state, packed
+                )
+            stepped = True
+            self.steps += 1
+            self.msgs_processed += len(consumed)
+        _t_pack = time.perf_counter_ns()
+        # dispatch is ASYNC: eg_packed is an in-flight device value; the
+        # ticket's realisation half syncs it (np.asarray) and processes
+        # the egress. The sequential step_once realises inline.
+        # Drain-produced AERs (fresh appends, ack-driven next_index
+        # moves) leave NOW, overlapping the device compute — holding
+        # them for realisation would delay the replication fan-out by a
+        # whole pipeline slot. Egress-produced AERs (commit advances)
+        # ride the ticket.
+        sent_aers = bool(aer_dirty)
+        if sent_aers:
+            self._send_aers(aer_dirty)
+            aer_dirty = set()
+        _t_aer0 = time.perf_counter_ns()
+        wh = self._wave_h
+        wh["ingress_drain"].record(_t_drain - _t_in)
+        if stepped:
+            wh["host_pack"].record(_t_pack - _t_drain)
+        if sent_aers:
+            wh["aer_fanout"].record(_t_aer0 - _t_pack)
+        tb = self._trace
+        if tb.enabled:
+            node = self.name
+            tb.span("ingress_drain", node, _t_in, _t_drain - _t_in)
+            if stepped:
+                tb.span("host_pack", node, _t_drain, _t_pack - _t_drain)
+            if sent_aers:
+                tb.span("aer_fanout", node, _t_pack, _t_aer0 - _t_pack)
+        return self._StepTicket(
+            eg_packed=eg_packed if stepped else None,
+            consumed=consumed, act=act_np, aer_dirty=aer_dirty, rare=rare,
+            mbox_buf=mbox_buf, t_in=_t_in, t_drain=_t_drain, t_pack=_t_pack,
+            stepped=stepped,
+        )
+
+    def _finish_ticket(self, ticket, eg_np: Optional[np.ndarray]) -> None:
+        """Realise one dispatched step: process the synced egress, run
+        the rare paths, fan out AERs (caller holds the state lock and
+        has already synced ``eg_np`` — ideally outside the lock)."""
+        aer_dirty = ticket.aer_dirty
+        _t_dev = None
+        if eg_np is not None:
             _t_dev = time.perf_counter_ns()
             # egress is host-synced: the device has fully consumed the
             # mailbox view, so the pack buffer may be reused
-            self._mbox_in_flight = False
+            self._mbox_release(ticket.mbox_buf)
             eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
-            self.steps += 1
-            self.msgs_processed += len(consumed)
-            self._process_egress(eg, consumed, aer_dirty)
-
-        for g, msg, from_sid in rare:
+            self._process_egress(eg, ticket.consumed, aer_dirty,
+                                 act=ticket.act)
+        for g, msg, from_sid in ticket.rare:
             # crash isolation for the slow paths (snapshot transfer
             # decode of untrusted bytes, membership, queries): a
             # poisoned message must not kill the step thread — every
@@ -842,14 +1248,13 @@ class BatchCoordinator:
         self._send_aers(aer_dirty)
         _t_aer = time.perf_counter_ns()
         # per-step wave-phase breakdown (obs.WAVE_PHASES). host_pack
-        # covers queued-scatter application + mailbox build; device_step
-        # is dispatch + egress host sync; host_egress includes apply and
-        # client replies (apply also gets its own per-group histogram).
+        # covered queued-scatter application + mailbox build + dispatch
+        # (recorded at dispatch time); device_step is the egress host
+        # sync (the device-compute wait); host_egress includes apply
+        # and client replies (apply also gets its own histogram).
         wh = self._wave_h
-        wh["ingress_drain"].record(_t_drain - _t_in)
-        if _t_pack is not None:
-            wh["host_pack"].record(_t_pack - _t_drain)
-            wh["device_step"].record(_t_dev - _t_pack)
+        if _t_dev is not None:
+            wh["device_step"].record(_t_dev - ticket.t_pack)
             wh["host_egress"].record(_t_eg - _t_dev)
         wh["aer_fanout"].record(_t_aer - _t_eg)
         tb = self._trace
@@ -858,13 +1263,11 @@ class BatchCoordinator:
             # spans: one lane per phase per node, so step-pipelining
             # overlap (or its absence) is visible in Perfetto
             node = self.name
-            tb.span("ingress_drain", node, _t_in, _t_drain - _t_in)
-            if _t_pack is not None:
-                tb.span("host_pack", node, _t_drain, _t_pack - _t_drain)
-                tb.span("device_step", node, _t_pack, _t_dev - _t_pack)
+            if _t_dev is not None:
+                tb.span("device_step", node, ticket.t_pack,
+                        _t_dev - ticket.t_pack)
                 tb.span("host_egress", node, _t_dev, _t_eg - _t_dev)
             tb.span("aer_fanout", node, _t_eg, _t_aer - _t_eg)
-        return True
 
     def _pad(self, rows, width: int):
         """Pad scatter batches to power-of-two buckets so XLA compiles a
@@ -890,19 +1293,21 @@ class BatchCoordinator:
     # -- ingress routing ---------------------------------------------------
 
     def _route_one(self, g: GroupHost, from_sid, msg, rare, appended,
-                   written, aer_dirty, route_out):
+                   written, aer_dirty, route_out, now_mono=None):
+        if now_mono is None:
+            now_mono = time.monotonic()
         if type(msg) is FromPeer:
             from_sid, msg = msg.peer, msg.msg
         t = type(msg)
         if t in MSG_OF_TYPE:
             if t is AppendEntriesRpc and msg.term >= g.term:
-                g.last_contact = time.monotonic()
+                g.last_contact = now_mono
             # host-side next_index bookkeeping rides on the same replies
             # the device will process
             elif t is AppendEntriesReply and g.role == C.R_LEADER:
                 slot = g.slot_of(from_sid)
                 if slot >= 0:
-                    g.last_ack[slot] = time.monotonic()
+                    g.last_ack[slot] = now_mono
                     if msg.success:
                         g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
                         if slot < len(g.match_hint):
@@ -1267,22 +1672,56 @@ class BatchCoordinator:
 
     # -- mailbox build -----------------------------------------------------
 
-    # packed mailbox row indexes (see C.MBOX_FIELDS)
-    _R = {name: i for i, name in enumerate(C.MBOX_FIELDS)}
+    # packed mailbox row indexes (see C.MBOX_FIELDS), plus the fused
+    # scatter rows that ride the same buffer (C.MBOX_SCAT_FIELDS)
+    _R = {
+        name: i
+        for i, name in enumerate(list(C.MBOX_FIELDS) + C.MBOX_SCAT_FIELDS)
+    }
+    _NROWS = len(C.MBOX_FIELDS) + len(C.MBOX_SCAT_FIELDS)
 
-    def _build_mailbox(self):
-        assert not self._mbox_in_flight, (
-            "mailbox buffer reused while a step still holds its view"
-        )
-        self._mbox_in_flight = True
-        cap = self.capacity
-        packed = self._mbox_buf
-        if packed is None:
-            packed = self._mbox_buf = np.zeros(
-                (len(C.MBOX_FIELDS), cap), np.int32
-            )
-        else:
-            packed.fill(0)
+    def _fill_scat(self, packed: np.ndarray, app_rows, written) -> None:
+        """Write the fused log-tail scatter rows: the newest appended
+        run per group and the durable watermarks, pad gid = capacity
+        (device scatters drop out-of-range rows)."""
+        R = self._R
+        packed[R["a_gid"]].fill(self.capacity)
+        packed[R["w_gid"]].fill(self.capacity)
+        if app_rows:
+            ar = np.asarray(app_rows, np.int64)
+            n = len(app_rows)
+            packed[R["a_gid"], :n] = ar[:, 0]
+            packed[R["a_lo"], :n] = ar[:, 1]
+            packed[R["a_hi"], :n] = ar[:, 2]
+            packed[R["a_term"], :n] = ar[:, 3]
+        if written:
+            n = len(written)
+            packed[R["w_gid"], :n] = np.fromiter(written.keys(), np.int64, n)
+            packed[R["w_idx"], :n] = np.fromiter(written.values(), np.int64, n)
+
+    def _mbox_take(self, width: Optional[int] = None) -> np.ndarray:
+        """Pop a zeroed pack buffer from the pool (full-width by
+        default, or a power-of-two sub-batch ``width``); allocates when
+        empty — pool size is bounded by the tickets in flight."""
+        if width is None:
+            width = self.capacity
+        pool = self._mbox_pool
+        for k, buf in enumerate(pool):
+            if buf.shape[1] == width:
+                del pool[k]
+                buf.fill(0)
+                return buf
+        return np.zeros((self._NROWS, width), np.int32)
+
+    def _mbox_release(self, buf: Optional[np.ndarray]) -> None:
+        """Return a pack buffer once its step's egress sync proves the
+        device consumed the zero-copy view."""
+        if buf is not None and len(self._mbox_pool) < 6:
+            self._mbox_pool.append(buf)
+
+    def _build_mailbox(self, app_rows=None, written=None):
+        packed = self._mbox_take()
+        self._fill_scat(packed, app_rows, written)
         R = self._R
         packed[R["host_term_idx"]].fill(-1)
         packed[R["host_term_val"]].fill(-1)
@@ -1345,9 +1784,9 @@ class BatchCoordinator:
                 m.entries[-1].term if m.entries else 0 for m in aer_m
             ]
             packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
-        return jnp.asarray(packed), consumed
+        return jnp.asarray(packed), consumed, packed
 
-    def _build_mailbox_sub(self, act):
+    def _build_mailbox_sub(self, act, app_rows=None, written=None):
         """Compact mailbox for the active-set step: one COLUMN PER
         ACTIVE GROUP (power-of-two padded), plus the gather index vector
         mapping column -> group id. ``consumed`` is keyed by column
@@ -1360,7 +1799,8 @@ class BatchCoordinator:
         cap = min(256, self.capacity)
         while cap < n:
             cap <<= 1
-        packed = np.zeros((len(C.MBOX_FIELDS), cap), np.int32)
+        packed = self._mbox_take(cap)
+        self._fill_scat(packed, app_rows, written)
         R = self._R
         packed[R["host_term_idx"]].fill(-1)
         packed[R["host_term_val"]].fill(-1)
@@ -1426,6 +1866,7 @@ class BatchCoordinator:
             jnp.asarray(gidx),
             np.asarray(act, np.int64),
             consumed,
+            packed,
         )
 
     def _encode(self, g: GroupHost, from_sid, msg, p, i) -> None:
@@ -2298,6 +2739,13 @@ class BatchCoordinator:
         if isinstance(msg, ElectionTimeout):
             if g.role == C.R_LEADER:
                 return
+            if msg.armed_at and g.last_contact > msg.armed_at:
+                # stale detector trigger: the group has seen contact (or
+                # restarted its own election window) since the suspicion
+                # was confirmed — a trigger delayed behind a stall (jit
+                # compile, long egress) must not pile a second election
+                # onto a round that is already resolving
+                return
             if g.voter_status.get(g.self_slot) != "voter":
                 return  # nonvoters never start elections
             self._obs_rec.record(
@@ -3004,7 +3452,8 @@ class BatchCoordinator:
                                 + random.random() * 2 * self.election_timeout_s
                             )
                             self.deliver(
-                                (g.name, self.name), ElectionTimeout(), None
+                                (g.name, self.name), ElectionTimeout(now),
+                                None,
                             )
             except Exception:  # noqa: BLE001
                 pass
@@ -3121,8 +3570,17 @@ class BatchCoordinator:
             leader = g.sid_of(g.leader_slot)
             if leader is not None and leader[1] == node_name:
                 delay = self.election_timeout_s * (1 + random.random())
+                # stamp the suspicion-confirmation time NOW: stamping at
+                # fire time would make the staleness guard in
+                # _handle_rare unable to drop the trigger when the
+                # leader re-establishes contact during the delay
+                armed = time.monotonic()
                 threading.Timer(
-                    delay, lambda gg=g: self.deliver((gg.name, self.name), ElectionTimeout(), None)
+                    delay,
+                    lambda gg=g, at=armed: self.deliver(
+                        (gg.name, self.name),
+                        ElectionTimeout(at), None,
+                    ),
                 ).start()
 
     def overview(self) -> dict:

@@ -126,6 +126,8 @@ class RaNode:
             sync_method=self.config.wal_sync_method,
             compute_checksums=self.config.wal_compute_checksums,
             threaded=True,
+            group_commit_max_delay_s=self.config.wal_group_commit_max_delay_s,
+            group_commit_min_gain=self.config.wal_group_commit_min_gain,
         )
         self.wal.fault_scope = name
         self.wal.on_failure = self._on_wal_failure

@@ -73,6 +73,13 @@ class SystemConfig:
     wal_max_batch_size: int = WAL_MAX_BATCH_SIZE
     wal_compute_checksums: bool = True
     wal_sync_method: str = "datasync"  # datasync | sync | none
+    # adaptive group commit (docs/INTERNALS.md §15): hold a small flush
+    # open up to this bound while a burst is still arriving so it pays
+    # one fsync; 0 disables. The wait is only entered when the smoothed
+    # arrival rate predicts >= wal_group_commit_min_gain more entries
+    # inside the bound — an idle write never waits on a timer.
+    wal_group_commit_max_delay_s: float = 0.002
+    wal_group_commit_min_gain: int = 8
     segment_max_entries: int = SEGMENT_MAX_ENTRIES
     # "map": parse segment indexes on open (fastest lookups);
     # "binary": binary-search raw slots + read-ahead (low memory for

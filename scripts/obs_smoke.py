@@ -85,15 +85,78 @@ def main() -> int:
     obs.trace_buffer().clear()
 
     # the bench filled the histograms (they outlive its teardown):
-    # every wave phase and all five commit stages must have fired
+    # every wave phase and all five commit stages must have fired. The
+    # adaptive group-commit flush_wait family must EXIST (a short smoke
+    # burst may legitimately never clear the coalescing gate, so its
+    # count may be 0 — presence is the gate).
     required_bench = (
         [rf"ra_wave_bench0_{ph}_seconds_count (\d+)"
          for ph, _ in obs.WAVE_PHASES]
         + [rf"ra_commit_bench0_{st}_seconds_count (\d+)"
            for st, _ in obs.COMMIT_STAGES]
         + [r"ra_wal_\w+_fsync_seconds_count (\d+)",
-           r"ra_wal_\w+_batch_seconds_count (\d+)"]
+           r"ra_wal_\w+_batch_seconds_count (\d+)",
+           r"ra_wal_\w+_flush_wait_seconds_count \d+"]
     )
+
+    # pipelined wave loop (docs/INTERNALS.md §15): a short cooperative
+    # stage/finish burst must PROVE overlap — staging/dispatching while
+    # the previous step was still in flight — via the counter the
+    # pipeline exists for. Kept alive (with one registered WAL) until
+    # the scrape below so the families are present in the exposition.
+    from ra_tpu.machine import SimpleMachine as _SM
+    from ra_tpu.protocol import Command, ElectionTimeout, USR
+    from ra_tpu.runtime.transport import NodeRegistry
+
+    pipe_reg = NodeRegistry()
+    pipe_coords = [
+        BatchCoordinator(f"pipe{i}", capacity=8, num_peers=3, nodes=pipe_reg)
+        for i in range(3)
+    ]
+    pipe_ids = [("pp", f"pipe{i}") for i in range(3)]
+    for c in pipe_coords:
+        c.add_group("pp", "ppcl", pipe_ids, _SM(lambda cm, s: s + cm, 0))
+
+    def _pipe_round():
+        worked = False
+        for c in pipe_coords:
+            worked = c.step_stage() or worked
+        for c in pipe_coords:
+            worked = c.step_finish() or worked
+        return worked
+
+    pipe_coords[0].deliver(pipe_ids[0], ElectionTimeout(), None)
+    deadline = time.time() + 30
+    while time.time() < deadline and (
+        pipe_coords[0].by_name["pp"].role != C.R_LEADER
+    ):
+        if not _pipe_round():
+            time.sleep(0.001)
+    for _ in range(5):
+        pipe_coords[0].deliver(
+            pipe_ids[0], Command(kind=USR, data=1, reply_mode="noreply"),
+            None,
+        )
+    while time.time() < deadline and not all(
+        c.by_name["pp"].machine_state == 5 for c in pipe_coords
+    ):
+        if not _pipe_round():
+            time.sleep(0.001)
+    if pipe_coords[0].counters.get("pipeline_overlap_ns") <= 0:
+        errors.append("pipelined burst recorded no staging overlap")
+
+    # one live registered WAL so the group-commit / native counter
+    # families are scrapeable (bench WALs unregister on teardown)
+    import pickle
+
+    from ra_tpu.log.tables import TableRegistry
+    from ra_tpu.log.wal import Wal
+
+    _wal_dir = tempfile.mkdtemp(prefix="obs_smoke_wal_")
+    smoke_wal = Wal(os.path.join(_wal_dir, "wal"), TableRegistry(),
+                    lambda u, e: None, threaded=False)
+    smoke_wal.write("su", 1, 1, pickle.dumps("x"))
+    smoke_wal.flush()
 
     # live cluster: counter vectors (deleted when a coordinator stops)
     # and the one-call system_overview surface
@@ -142,6 +205,15 @@ def main() -> int:
             r"# TYPE ra_commit_rate gauge",
             r"# TYPE ra_commands_rejected counter",
             r"ra_lane_wedges",  # presence only: 0 is the healthy value
+            # pipelined wave loop: the coop burst above must show
+            # overlap > 0 (the (\d+)-zero check enforces nonzero)
+            r"ra_pipeline_overlap_ns\{[^}]*pipe0[^}]*\} (\d+)",
+            r"ra_pipeline_steps\{[^}]*pipe0[^}]*\} (\d+)",
+            # adaptive group-commit gauge family (wal counters register
+            # per-scope; the smoke WAL below keeps one alive to scrape)
+            r"# TYPE ra_group_commit_delay_us gauge",
+            r"# TYPE ra_group_commit_waits counter",
+            r"# TYPE ra_native_batches counter",
             # health plane families (docs/INTERNALS.md §14)
             r"ra_health_scans\{[^}]*obs0[^}]*\} (\d+)",
             r"ra_health_fetches\{[^}]*obs0[^}]*\} (\d+)",
@@ -198,6 +270,15 @@ def main() -> int:
     finally:
         for c in coords:
             c.stop()
+        for c in pipe_coords:
+            c.stop()
+        try:
+            smoke_wal.close()
+        except Exception:  # noqa: BLE001
+            pass
+        import shutil
+
+        shutil.rmtree(_wal_dir, ignore_errors=True)
         leaderboard.clear()
 
     if errors:
@@ -212,4 +293,11 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # hard exit: the verdict is printed and all checks are done — the
+    # smoke run leaves many device-touching threads (WAL writers,
+    # detector loops, XLA dispatch) whose interpreter-teardown race can
+    # abort an otherwise-green gate
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
