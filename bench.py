@@ -80,7 +80,8 @@ def _retry_on_cpu_or_fail() -> None:
 
 
 def bench_pipeline(groups: int, cmds: int, wal: bool = True,
-                   workdir: str = None, pipeline="on") -> dict:
+                   workdir: str = None, pipeline="on",
+                   rings: str = "on") -> dict:
     """Multi-raft pipeline bench. Modes (``pipeline``):
 
     - ``"on"`` (default): the pipelined wave loop in its cooperative
@@ -102,6 +103,9 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
     elif pipeline is False:
         pipeline = "off"
     assert pipeline in ("on", "off", "threaded")
+    # rings=off: the lock+deque control command plane (A/B is this one
+    # flag; docs/INTERNALS.md §16)
+    assert rings in ("on", "off")
     import jax
     import jax.numpy as jnp
 
@@ -141,7 +145,8 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
 
     coords = [
         BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3,
-                         idle_sleep_s=0, pipeline=pipeline != "off")
+                         idle_sleep_s=0, pipeline=pipeline != "off",
+                         rings=rings == "on")
         for i in range(3)
     ]
     storage = []
@@ -457,11 +462,26 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             distribution."""
             cmd = Command(kind=USR, data=1, reply_mode="noreply")
             stride = lat_stride
+            by0 = coords[0].by_name
             for k in range(n_waves):
                 rot = (lat_sample + (k % stride)) % groups
                 rot_names = [f"g{g}" for g in rot]
                 base[rot] += 1
                 done = np.zeros(len(rot), bool)
+                # threaded mode: completion must read the MACHINE
+                # mirrors, not the applied-index floor — live-thread
+                # re-elections append noops that advance the floor
+                # without advancing ``base``, so the floor check reads
+                # complete one command early per churn event and the
+                # wave's commands drift past the phase boundary (they
+                # then land inside a throughput pass and read as a
+                # duplicated command in its +cmds state check; the
+                # same inflation is why run_wave checks mirrors since
+                # the threaded-completion fix)
+                ms0 = (
+                    [by0[n].machine_state for n in rot_names]
+                    if pipeline == "threaded" else None
+                )
                 t0 = time.perf_counter()
                 coords[0].deliver_commands(
                     rot_names, cmd._replace(ts=time.monotonic_ns())
@@ -475,7 +495,15 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                         # fsync thread — hand it the core immediately
                         time.sleep(0)
                     now = time.perf_counter()
-                    newly = ~done & (coords[0]._applied_np[rot] >= base[rot])
+                    if ms0 is not None:
+                        newly = ~done & np.array([
+                            by0[rot_names[j]].machine_state - ms0[j] >= 1
+                            for j in range(len(rot))
+                        ])
+                    else:
+                        newly = ~done & (
+                            coords[0]._applied_np[rot] >= base[rot]
+                        )
                     if newly.any():
                         h_unloaded.record_seconds(now - t0, count=int(newly.sum()))
                         done |= newly
@@ -537,8 +565,47 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
         # commands in flight across as many raft lanes
         ADMIT_WINDOW = 1
         total = groups * cmds
+
+        def settle_mirrors() -> None:
+            """Threaded mode: the applied-index floors the settle/wave
+            checks compare against ``base`` are noop-inflatable — a
+            mid-phase re-election (detector suspicion under GIL load)
+            appends a noop that advances the floor without advancing
+            ``base`` or the machine, so a floor-based settle can pass
+            while a latency-phase command is still in flight. That
+            straggler then applies AFTER the pass baseline is captured
+            and reads as a duplicated command in the +cmds state check
+            (seen as advance==cmds+1 across the fleet at 2048x24).
+            Wait for the leader-side machine MIRRORS to go still before
+            taking baselines; cooperative modes settle exactly via
+            step_all and never need this."""
+            if pipeline != "threaded":
+                return
+            by = coords[0].by_name
+            last = None
+            last_t = time.time()
+            while time.time() - last_t < 15:
+                cur = [by[f"g{g}"].machine_state for g in range(groups)]
+                if cur != last:
+                    last, last_t = cur, time.time()
+                elif time.time() - last_t >= 0.25:
+                    return
+                time.sleep(0.01)
+
         best = 0.0
         for _pass in range(3):
+            if os.environ.get("RA_BENCH_DEBUG"):
+                _ms0 = sum(coords[0].by_name[f"g{g}"].machine_state
+                           for g in range(groups))
+                _t_s = time.time()
+            settle_mirrors()
+            if os.environ.get("RA_BENCH_DEBUG"):
+                _ms1 = sum(coords[0].by_name[f"g{g}"].machine_state
+                           for g in range(groups))
+                print(f"DBG pass{_pass}: settle {time.time()-_t_s:.2f}s "
+                      f"mirror_sum {_ms0}->{_ms1} "
+                      f"floor_sum {int(coords[0]._applied_np[:groups].sum())} "
+                      f"base_sum {int(base.sum())}", file=sys.stderr)
             # per-group baselines: the latency warmup advances only the
             # sampled groups, so states are not uniform across groups
             state0 = [
@@ -613,6 +680,8 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                                 "threads) + decoupled durable acks",
                     "off": "sequential cooperative loop (control)",
                 }[pipeline] + ", "
+                + ("lock-free ingress rings" if rings == "on"
+                   else "lock+deque control plane") + ", "
                 f"device {jax.devices()[0].platform}, "
                 f"best of 3 passes; p50/p99 = unloaded commit latency "
                 f"over {lat_waves} rotating {lat_w}-group waves "
@@ -623,6 +692,17 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                 f"unbounded_loaded_* = the pre-queued comparison shape)"
             ),
             "pipeline": pipeline,
+            "rings": rings,
+            "ring_counters": {
+                k: int(sum(c.counters.get(k) for c in coords))
+                for k in (
+                    "ingress_ring_msgs", "ingress_ring_drains",
+                    "ingress_ring_full", "staging_passes",
+                    "staging_prezeroed", "egress_thread_batches",
+                    "egress_thread_msgs", "step_wakeups",
+                    "step_spurious_wakeups", "pipeline_overlap_ns",
+                )
+            },
             "value": round(best, 1),
             "unit": "commands/sec",
             "vs_baseline": round(best / 100_000.0, 3),
@@ -749,6 +829,11 @@ def main() -> None:
                          "is this one flag); threaded: started "
                          "two-stage loops (the production shape, "
                          "recorded as a secondary artifact)")
+    ap.add_argument("--rings", choices=("on", "off"), default="on",
+                    help="on (default): lock-free per-producer ingress "
+                         "rings + event-driven wakeups; off: the "
+                         "lock+deque control command plane (same-box "
+                         "A/B is this one flag)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -764,7 +849,7 @@ def main() -> None:
         g = args.groups or (128 if args.smoke else 10240)
         out = bench_pipeline(g, args.cmds or (3 if args.smoke else 96),
                              wal=not args.no_wal, workdir=args.workdir,
-                             pipeline=args.pipeline)
+                             pipeline=args.pipeline, rings=args.rings)
     print(json.dumps(out))
 
 

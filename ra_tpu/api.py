@@ -249,7 +249,15 @@ def process_command(
 
     An overloaded leader replies ``("reject", "overloaded")`` (admission
     window full — see docs/INTERNALS.md §12): the command was NOT
-    appended, so the bounded-backoff retry below is exactly-once safe."""
+    appended, so the retry below is exactly-once safe. Rejects (both
+    backends) carry a gate waiter as a third element — a
+    threading.Event the server SETS when the window releases (apply
+    progress frees admission room, or an ingress-ring drain frees lane
+    space) — so the retry is woken by the release itself instead of a
+    fixed sleep poll;
+    the bounded backoff stays only as the upper wait bound (deadline
+    semantics are unchanged, and a reject never appended anything, so
+    the retry remains exactly-once)."""
     deadline = time.monotonic() + timeout
     target = server_id
     tried: set = set()
@@ -298,10 +306,19 @@ def process_command(
             continue
         if reply[0] == "reject":
             # reject-with-backoff: the leader's admission window is
-            # full. Hold off (bounded exponential), then retry the SAME
-            # leader — the command was never appended, so no duplicate
-            # risk. tried is not updated: this member is healthy.
-            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            # full. Hold off, then retry the SAME leader — the command
+            # was never appended, so no duplicate risk. tried is not
+            # updated: this member is healthy. When the reject carries
+            # a window-release gate (both backends do), park on IT —
+            # the server wakes us the moment apply progress (or a ring
+            # drain) frees room, so the backoff only bounds the wait;
+            # a bare 2-tuple reject falls back to the bounded sleep.
+            wait_s = min(backoff, max(0.0, deadline - time.monotonic()))
+            gate = reply[2] if len(reply) > 2 else None
+            if gate is not None:
+                gate.wait(wait_s)
+            else:
+                time.sleep(wait_s)
             backoff = min(backoff * 2, 0.25)
             continue
         raise RaError(f"command failed: {reply!r}")
@@ -313,6 +330,27 @@ def _try_send(sid: ServerId, msg: Any) -> bool:
     if node is None:
         return False
     return node.deliver(sid, msg, None)
+
+
+def _try_send_many(sid: ServerId, msgs: list) -> int:
+    """Bulk client ingress: deliver ``msgs`` to one server in a single
+    handoff when the backend supports it (the batch coordinator's
+    ``deliver_many`` — ONE ingress-ring slot for the whole burst,
+    docs/INTERNALS.md §16), else loop ``deliver``. Returns the number
+    handed to the node (an upper bound on what arrives: bulk items may
+    still shed at drain under the backend's overload policy)."""
+    node = node_registry().get(sid[1])
+    if node is None:
+        return 0
+    dm = getattr(node, "deliver_many", None)
+    if dm is not None:
+        dm([(sid, m, None) for m in msgs])
+        return len(msgs)
+    n = 0
+    for m in msgs:
+        if node.deliver(sid, m, None):
+            n += 1
+    return n
 
 
 def _next_target(origin: ServerId, current: ServerId, tried: set) -> ServerId:

@@ -131,6 +131,46 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
      "a previous step's device compute / egress realisation was still "
      "in flight — the overlap the pipelined wave loop creates; 0 on "
      "the sequential loop (docs/INTERNALS.md §15)"),
+    # -- async command plane (docs/INTERNALS.md §16) --------------------
+    ("ingress_ring_msgs", "counter",
+     "items drained from the lock-free ingress rings (a bulk fan-out "
+     "or per-node batch counts as one item)"),
+    ("ingress_ring_drains", "counter",
+     "batched multi-lane ring drain passes run by the step thread"),
+    ("ingress_ring_full", "counter",
+     "publishes that hit a full ingress lane (backpressure: client "
+     "commands reject through the admission path, lossy protocol "
+     "traffic is counted and dropped, control messages gate-wait — "
+     "never a silent drop)"),
+    ("ingress_ring_lanes", "gauge",
+     "ingress lanes registered (one per producer thread)"),
+    ("ingress_overflow_msgs", "counter",
+     "must-deliver items parked on the overflow queue after a full-"
+     "lane publish (snapshot traffic, TimeoutNow, internal commands: "
+     "never shed, never gate-waited — a foreign drainer thread parked "
+     "on our gate while we park on its gate would deadlock)"),
+    ("staging_passes", "counter",
+     "ingest-only passes that folded drained work into the staged "
+     "scatter buffers while a device step was still in flight"),
+    ("staging_prezeroed", "counter",
+     "mailbox pack buffers pre-zeroed inside the pipeline overlap "
+     "window (the dispatch pass then packs into the spare buffer with "
+     "no take/zero cost on the critical path)"),
+    ("egress_thread_batches", "counter",
+     "per-destination message batches shipped by the dedicated egress "
+     "sender thread (off the step loop)"),
+    ("egress_thread_msgs", "counter",
+     "messages shipped by the dedicated egress sender thread"),
+    ("egress_thread_ring_full", "counter",
+     "egress handoffs that overflowed the bounded sender ring and were "
+     "sent inline instead (bounded handoff never drops)"),
+    ("step_wakeups", "counter",
+     "times the idle step thread was woken (ring publish, WAL notify, "
+     "egress realisation, stop) — the event-driven replacement for the "
+     "old 50 ms timed polls"),
+    ("step_spurious_wakeups", "counter",
+     "wakeups that found no work (must stay 0 while idle: the "
+     "zero-spurious-wakeups invariant of the async command plane)"),
 ]
 
 # Per-node health-plane vector (name ("health", node_name); written

@@ -48,7 +48,7 @@ import errno as _errno
 import random
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class ThreadCrash(BaseException):
@@ -93,6 +93,29 @@ class _Failpoint:
 _lock = threading.Lock()
 _armed: Dict[str, _Failpoint] = {}
 
+# arm-wakers: callbacks invoked after every arm() (docs/INTERNALS.md
+# §16). Event-driven idle loops (the WAL writer's untimed wait) need a
+# nudge when a failpoint is armed against an IDLE thread — a parked
+# loop re-checks its armed sites on wake, so a crash_thread nemesis
+# still bites within one wakeup even with zero traffic. Callbacks must
+# be cheap and never raise; registration is idempotent per callback.
+_arm_wakers: List = []
+
+
+def on_arm(cb) -> None:
+    """Register ``cb()`` to run after every ``arm()``."""
+    with _lock:
+        if cb not in _arm_wakers:
+            _arm_wakers.append(cb)
+
+
+def off_arm(cb) -> None:
+    with _lock:
+        try:
+            _arm_wakers.remove(cb)
+        except ValueError:
+            pass
+
 # built-in sites whose call sites DO NOT pass a scope label: arming
 # them with a scope would be a silent no-op (the _take scope filter
 # would reject every hit), so arm() refuses. tcp.* sites ARE scoped,
@@ -126,6 +149,12 @@ def arm(site: str, action: Tuple, trigger: Tuple = ("one_shot",),
         )
     with _lock:
         _armed[site] = fp
+        wakers = list(_arm_wakers)
+    for cb in wakers:
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — a waker must never block arming
+            pass
 
 
 def disarm(site: str) -> None:

@@ -121,6 +121,7 @@ def run(
     rescue: bool = False,
     disk_faults: bool = False,
     overload: bool = False,
+    rings: bool = True,
 ) -> HarnessResult:
     """``rescue=True`` lets the harness fire operator election kicks on
     a stuck deployment (useful when hunting consistency bugs past a
@@ -134,7 +135,12 @@ def run(
     infra-thread crash — ``_DISK_FAULT_MENU``) against a random node's
     storage. On the batch backend, ``restarts=True`` and/or
     ``disk_faults=True`` switch the groups onto WAL-backed logs and add
-    coordinator crash-restarts recovering from disk."""
+    coordinator crash-restarts recovering from disk.
+
+    ``rings=False`` runs the batch backend on the lock+deque control
+    command plane instead of the lock-free ingress rings (docs/
+    INTERNALS.md §16) — the soak's A/B escape hatch; the actor backend
+    ignores it."""
     if restarts is None:
         # backend defaults: member restarts have always been part of the
         # actor mix; batch coordinator crash-restarts (WAL-backed
@@ -148,7 +154,7 @@ def run(
         return _run_batch(seed, n_ops, nodes, partitions, membership,
                           op_timeout, rescue, restarts=restarts,
                           disk_faults=disk_faults, data_dir=data_dir,
-                          overload=overload)
+                          overload=overload, rings=rings)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -266,15 +272,30 @@ def _overload_phase(model, cluster, op_timeout, counts, seed) -> None:
         t.start()
     # ack-free flood straight past the server admission window: these
     # may be DROPPED (counted) but must never duplicate — the final
-    # ov_flood total is bounded by the flood size
+    # ov_flood total is bounded by the flood size. The flood lands in
+    # BURSTS (api._try_send_many: one ingress handoff per chunk) so the
+    # append side sees window-sized batches — with the event-driven
+    # command plane draining per publish, a one-at-a-time flood gets
+    # absorbed at line rate and the window is never exceeded
     flood_cmd_total = 0
-    for _ in range(_OVERLOAD_FLOOD):
-        for sid in cluster:
-            if api._try_send(
-                sid, Command(kind=USR, data=("incr", "ov_flood", 1),
-                             reply_mode="noreply")
-            ):
-                flood_cmd_total += 1
+    flood_cmd = Command(kind=USR, data=("incr", "ov_flood", 1),
+                        reply_mode="noreply")
+    chunk = [flood_cmd] * (_OVERLOAD_BACKLOG * 3)
+    for _ in range(_OVERLOAD_FLOOD // len(chunk) + 1):
+        # the flood must actually land on the LEADER: after a nemesis
+        # with membership ops, leadership may sit on a node outside the
+        # original member list (a joined spare) — followers just
+        # redirect ack-free commands, and a flood that only ever hits
+        # followers never exceeds anyone's window (this was a real
+        # flake: 3/3 soak seeds failed the counters-fired assert
+        # whenever the spare led)
+        targets = set(cluster)
+        cl_name = api._cluster_of(cluster[0])
+        lead = leaderboard.lookup_leader(cl_name) if cl_name else None
+        if lead is not None:
+            targets.add(lead)
+        for sid in targets:
+            flood_cmd_total += api._try_send_many(sid, chunk)
     for t in threads:
         t.join(timeout=op_timeout * _OVERLOAD_OPS)
     phase_s = time.monotonic() - t_phase
@@ -565,7 +586,7 @@ def _dump_on_failure(failures, label: str, anomalies=None) -> None:
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
-               data_dir=None, overload=False) -> HarnessResult:
+               data_dir=None, overload=False, rings=True) -> HarnessResult:
     import tempfile
 
     from ra_tpu.log.log import Log
@@ -623,6 +644,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
             n, capacity=8, num_peers=nodes + 1, tick_interval_s=0.3,
             meta=storage[n]["meta"] if use_disk else None,
             max_command_backlog=_OVERLOAD_BACKLOG if overload else 4096,
+            rings=rings,
         )
         if use_disk:
             storage[n]["ref"]["c"] = c
@@ -888,10 +910,13 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
                           "crash-restarts over WAL-backed logs on tpu_batch)")
     grp.add_argument("--no-restarts", dest="restarts", action="store_false",
                      help="force the restart dimension off")
+    ap.add_argument("--rings", choices=("on", "off"), default="on",
+                    help="off: batch backend runs the lock+deque "
+                         "control command plane (A/B escape hatch)")
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
               restarts=args.restarts, disk_faults=args.disk_faults,
-              overload=args.overload)
+              overload=args.overload, rings=args.rings == "on")
     print(f"ops={res.ops} consistent={res.consistent}")
     for f in res.failures:
         print("FAILURE:", f)

@@ -80,7 +80,10 @@ class SyncPool:
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
-                    self._cv.wait(timeout=0.5)
+                    # event-driven idle: sync_path notifies on every
+                    # enqueue and close() notifies all — idle workers
+                    # consume zero CPU (docs/INTERNALS.md §16)
+                    self._cv.wait()
                 if self._closed and not self._queue:
                     return
                 path, done, slot = self._queue.popleft()

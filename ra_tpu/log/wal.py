@@ -188,8 +188,17 @@ class Wal:
 
         self._thread: Optional[threading.Thread] = None
         if threaded:
+            # arm-waker: the idle loop below blocks UNTIMED when no
+            # wal.thread failpoint is armed; arming one while the
+            # writer is parked must wake it so the crash bites within
+            # one wakeup even with zero traffic (docs/INTERNALS.md §16)
+            faults.on_arm(self._arm_wake)
             self._thread = threading.Thread(target=self._run, name="ra-wal", daemon=True)
             self._thread.start()
+
+    def _arm_wake(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
 
     # ------------------------------------------------------------------
     # public API
@@ -265,6 +274,7 @@ class Wal:
                                  cat="wal")
 
     def close(self) -> None:
+        faults.off_arm(self._arm_wake)
         with self._cv:
             self._closed = True
             self._cv.notify_all()
@@ -291,10 +301,20 @@ class Wal:
             faults.fire("wal.thread", self.fault_scope)
             with self._cv:
                 while not self._queue and not self._closed:
-                    self._cv.wait(timeout=0.5)
-                    # idle loop checks the site too: a crash_thread
-                    # nemesis must bite within one wait tick even with
-                    # no traffic (the cv lock releases on unwind)
+                    # event-driven idle (docs/INTERNALS.md §16):
+                    # producers notify on empty->non-empty, close()
+                    # notifies all, and faults.arm() nudges via the
+                    # arm-waker — an idle WAL writer consumes zero
+                    # CPU. The timed tick survives ONLY while a
+                    # wal.thread failpoint is armed: a crash_thread
+                    # nemesis must keep biting within one tick while
+                    # its trigger (e.g. prob) rolls the dice
+                    if faults.any_armed("wal.thread"):
+                        self._cv.wait(timeout=0.5)
+                    else:
+                        self._cv.wait()
+                    # idle loop checks the site too (the cv lock
+                    # releases on unwind)
                     faults.fire("wal.thread", self.fault_scope)
                 if self._closed and not self._queue:
                     return

@@ -291,6 +291,29 @@ class InfoReply:
     machine_version: int
 
 
+# Peer protocol traffic the transport contract allows to drop: every
+# type here is periodically retried/resent by its sender (AER resend
+# windows, election retry timers, heartbeat ticks), so a full ingress
+# lane sheds it with a counter instead of blocking the producer
+# (docs/INTERNALS.md §16 backpressure table). Everything NOT listed —
+# client commands (they reject through the admission path), log
+# events, snapshot chunks, queries — must never be silently dropped.
+LOSSY_PROTOCOL_TYPES = frozenset((
+    AppendEntriesRpc, AppendEntriesReply,
+    RequestVoteRpc, RequestVoteResult,
+    PreVoteRpc, PreVoteResult,
+    HeartbeatRpc, HeartbeatReply,
+))
+
+# Client-visible admission reject reply: ``("reject", "overloaded")``,
+# optionally extended with a third element — a ``threading.Event`` the
+# server sets when the admission window (or a full ingress lane)
+# releases, so ``api.process_command`` parks on the release instead of
+# sleeping a fixed backoff. The gate is process-local (never pickled:
+# rejects are generated by the node the client called).
+REJECT_OVERLOADED = ("reject", "overloaded")
+
+
 # -- events delivered to the server core (non-peer messages) ---------------
 
 

@@ -75,7 +75,9 @@ from ra_tpu.protocol import (
     InstallSnapshotAck,
     InstallSnapshotResult,
     InstallSnapshotRpc,
+    LOSSY_PROTOCOL_TYPES,
     NOOP,
+    REJECT_OVERLOADED,
     PreVoteResult,
     PreVoteRpc,
     RA_CLUSTER_CHANGE,
@@ -258,6 +260,9 @@ class BatchCoordinator:
         max_command_backlog: int = 4096,
         command_deadline_s: float = 5.0,
         pipeline: bool = True,
+        rings: bool = True,
+        ingress_ring_slots: int = 8192,
+        egress_async: bool = True,
     ):
         self.name = node_name
         self.capacity = capacity
@@ -357,17 +362,72 @@ class BatchCoordinator:
         self.by_name: Dict[str, GroupHost] = {}
         self.n_groups = 0
 
-        self._ingress: deque = deque()
-        self._ingress_cv = threading.Condition()
-        # client commands bypass the generic ingress: they are routed to
-        # per-group lists at DELIVERY time (same lock round), so the
-        # step drain iterates groups instead of re-classifying every
-        # message — at 10k groups x pipelined waves the regrouping pass
-        # was a top-3 hot spot
-        self._cmd_q: Dict[str, List[Command]] = {}
+        # async command plane (docs/INTERNALS.md §16): per-producer-
+        # thread lock-free SPSC ingress rings, drained in one batched
+        # multi-lane pass by the step thread. No sender ever contends
+        # with the step loop; the step thread blocks on _wake (an
+        # Event set by every publish / WAL notify / egress realisation)
+        # instead of 50 ms timed polls. rings=False swaps in the
+        # lock+deque control implementation (the --rings=off A/B).
+        from ra_tpu.rings import IngressRings, LockedLanes, WaitGate
+
+        self._wake = threading.Event()
+        ring_cls = IngressRings if rings else LockedLanes
+        self.rings = rings
+        self._rings = ring_cls(lane_slots=ingress_ring_slots,
+                               wake=self._wake)
+        # ring-full backpressure gate: opened on every drain that freed
+        # space; ring-full-rejected clients wait on it instead of
+        # sleeping (the ingress analog of the per-group admission gate)
+        self._ring_gate = WaitGate()
+        # admission-window gate: opened whenever apply progress releases
+        # window room; admission-rejected clients park a waiter on it
+        # (api.process_command) instead of a fixed 10 ms sleep poll
+        self._adm_gate = WaitGate()
+        # idents of the threads that DRAIN the rings (step + egress loop
+        # threads, plus whichever thread is inside a cooperative step_*
+        # call): a full-ring publish from one of these must divert to
+        # _internal_q — gate-waiting would deadlock on itself
+        self._drainer_idents: set = set()
+        # reusable drain scratch (step-thread only)
+        self._drain_buf: List = []
+        # must-deliver self-publishes from the coordinator's own step/
+        # egress threads (machine Append/Aux effects): a blocking ring
+        # publish from the drainer thread would deadlock, so they ride
+        # this state-lock-guarded queue into the next drain instead
+        self._internal_q: deque = deque()
+        # must-deliver overflow from FOREIGN threads (peer coordinator
+        # step/egress/WAL threads, detector timers) whose publish hit a
+        # full lane: never dropped, and never gate-waited either — a
+        # peer's drainer thread parked on OUR ring gate while we park
+        # on ITS gate is a distributed deadlock. Tiny leaf lock (never
+        # nested inside any other), folded first by _drain_classify so
+        # overflow items keep their arrival seniority.
+        self._overflow_q: deque = deque()
+        self._overflow_lock = threading.Lock()
         self._low_dirty: set = set()  # gids with buffered low-priority cmds
-        # ("a", gid, lo, hi, term) appended runs | ("w", gid, idx) durable
-        self._pending_scatters: List[Tuple] = []
+        # staged device scatters, coalesced ACROSS passes (the host half
+        # of the double-buffered staging): appended runs per gid as
+        # [[lo, hi, term], ...] chronological, durable watermarks as
+        # gid -> max idx. Ingest-only passes fold straight into these;
+        # the next dispatching pass consumes them with zero re-merging.
+        self._staged_app: Dict[int, List[List[int]]] = {}
+        self._staged_written: Dict[int, int] = {}
+        # pre-zeroed full-width mailbox buffer staged in the pipeline
+        # overlap window (dispatch packs into it with no take/zero cost)
+        self._spare_mbox: Optional[np.ndarray] = None
+        # prezero only while full-width steps are the live shape (the
+        # active-set sub path zeroes tiny buffers — not worth staging)
+        self._prezero_useful = False
+        # dedicated egress sender thread (started pipelined loop only):
+        # AER/ack fan-out hands (node, msgs) batches to a bounded ring
+        # consumed off the step loop; overflow falls back to inline send
+        self._egress_async = egress_async
+        self._egress_on = False
+        self._egress_wake = threading.Event()
+        self._egress_rings = ring_cls(lane_slots=4096,
+                                      wake=self._egress_wake)
+        self._sender_thread: Optional[threading.Thread] = None
         # role transitions queued by rare paths, applied as ONE scatter
         # at the start of the next step (an election storm over many
         # groups must not pay one jitted scatter per group)
@@ -407,19 +467,12 @@ class BatchCoordinator:
         self._pipe_q: deque = deque()
         self._pipe_inflight = 0  # tickets dispatched but not finished
         self._egress_thread: Optional[threading.Thread] = None
-        # lost-wakeup guard for the pipelined idle wait: realisation
-        # and the decoupled durable-ack path produce step work (_hot,
-        # pending scatters) under the STATE lock, so the idle predicate
-        # below can read stale emptiness; the flag is set under the
-        # ingress cv right before their notify and consumed by the
-        # step thread, making every produced-work notify land
-        self._step_wake = False
         # work drained by ingest-only passes (a ticket still in
-        # flight): rares and AER-dirty gids park here until the next
-        # dispatching pass picks them up (appended/written runs go
-        # straight to _pending_scatters, their canonical deferred form)
+        # flight): rares park here until the next dispatching pass
+        # picks them up (appended/written runs go straight to the
+        # staged scatter dicts, their canonical form; AER fan-out
+        # never parks — ingest passes ship it immediately)
         self._pending_rare: List[Tuple] = []
-        self._pending_aer: set = set()
         # outstanding ticket of the cooperative pipelined driver form
         self._coop_ticket: Optional[BatchCoordinator._StepTicket] = None
         self._step_thread = threading.Thread(
@@ -438,58 +491,125 @@ class BatchCoordinator:
     def procs(self) -> Dict[str, Any]:
         return self.by_name
 
+    # ring item tags: generic message | single command | bulk command
+    # fan-out | per-node batch of (name, from_sid, msg) triples
+    _R_MSG, _R_CMD, _R_CMDS, _R_BATCH = 0, 1, 2, 3
+
     def deliver(self, to: ServerId, msg: Any, from_sid: Optional[ServerId]) -> bool:
-        g = self.by_name.get(to[0])
-        if g is None:
+        """Lock-free ingress: publish onto this thread's SPSC lane. A
+        full lane backpressures explicitly (docs/INTERNALS.md §16):
+        client commands owing a reply reject through the admission
+        path with a gate waiter, ack-free commands drop counted
+        (at-most-once contract), lossy peer protocol traffic drops
+        counted (transport contract), and must-deliver control
+        messages (log events, internal commands, queries) ride the
+        overflow queue — never a silent drop, and never a block (the
+        caller may be a peer coordinator's drainer thread; parking it
+        on our gate while we park on its gate would deadlock)."""
+        name = to[0]
+        if name not in self.by_name:
             return False
-        with self._ingress_cv:
-            if type(msg) is Command:
-                self._enqueue_cmd(to[0], g, msg)
-            else:
-                self._ingress.append((to[0], from_sid, msg))
-            self._ingress_cv.notify()
+        if type(msg) is Command:
+            if msg.internal and self._overflow_q:
+                # older must-deliver work is parked on the overflow
+                # queue: a lane publish would overtake it (the queue
+                # folds after the lane drain) — keep arrival order
+                return self._publish_overflow((self._R_CMD, name, msg))
+            if self._rings.publish((self._R_CMD, name, msg)):
+                return True
+            return self._ring_full_cmd(name, msg)
+        if type(msg) not in LOSSY_PROTOCOL_TYPES and self._overflow_q:
+            return self._publish_overflow((self._R_MSG, name, from_sid, msg))
+        if self._rings.publish((self._R_MSG, name, from_sid, msg)):
+            return True
+        self.counters.incr("ingress_ring_full")
+        if type(msg) in LOSSY_PROTOCOL_TYPES:
+            return False  # lossy peer traffic: counted drop
+        return self._publish_overflow((self._R_MSG, name, from_sid, msg))
+
+    def _ring_full_cmd(self, name: str, msg: Command) -> bool:
+        self.counters.incr("ingress_ring_full")
+        if msg.internal:
+            # machine-internal must-deliver (timer fires, Append
+            # effects): overflow queue, never shed
+            return self._publish_overflow((self._R_CMD, name, msg))
+        if msg.from_ref is not None:
+            # explicit backpressure: the command was NEVER enqueued, so
+            # a retry is exactly-once safe; the gate waiter wakes the
+            # client on the next drain instead of a sleep loop
+            self.counters.incr("commands_rejected")
+            self._reply(
+                msg.from_ref,
+                REJECT_OVERLOADED + (self._ring_gate.waiter(),),
+            )
+            return True
+        self.counters.incr("commands_dropped_overload")
+        return False
+
+    def _publish_blocking(self, item) -> bool:
+        """Bounded-wait publish for must-deliver BULK CLIENT traffic
+        (deliver_commands / deliver_many — the producers there are
+        client/driver threads, where waiting IS the backpressure): wait
+        on the ring gate (opened by every space-freeing drain) and
+        retry. A drainer thread (step/egress loop, or a cooperative
+        step_* call) must never gate-wait on itself — its must-deliver
+        traffic rides ``_internal_q`` into its own next drain instead.
+        Never used for traffic that may originate on ANOTHER
+        coordinator's drainer thread (see _publish_overflow)."""
+        if threading.get_ident() in self._drainer_idents:
+            # caller holds the state lock (every drainer publish comes
+            # from inside a locked stage/realise half)
+            self._internal_q.append(item)
+            return True
+        for _ in range(4):
+            if not self.running:
+                return False
+            if self._rings.publish(item):
+                return True
+            self._ring_gate.waiter().wait(0.05)
+        # still full after the bounded wait: in cooperative (non-
+        # started) mode the only drainer may be THIS thread between
+        # step_* calls — spinning here would livelock until an external
+        # stop(). Fall back to the overflow queue: delivered on the
+        # next drain, never spun on, never shed.
+        return self._publish_overflow(item)
+
+    def _publish_overflow(self, item) -> bool:
+        """Non-blocking must-deliver fallback for a full lane: park the
+        item on the overflow queue the next _drain_classify folds FIRST
+        (arrival seniority kept). Used for traffic whose producer may
+        be a peer coordinator's drainer thread or a timer — blocking
+        those risks distributed deadlock, dropping violates the
+        must-deliver contract. Unbounded, but only ever fed by the
+        low-rate control/ack trickle that outlived a full lane."""
+        if threading.get_ident() in self._drainer_idents:
+            self._internal_q.append(item)
+            return True
+        with self._overflow_lock:
+            self._overflow_q.append(item)
+        self.counters.incr("ingress_overflow_msgs")
+        if not self._wake.is_set():
+            self._wake.set()
         return True
 
-    def _enqueue_cmd(self, name: str, g: Optional[GroupHost], msg: Command) -> None:
-        """Route one client command (caller holds the ingress lock)."""
-        if msg.priority == "low":
-            if g is None:
-                g = self.by_name.get(name)
-            if g is not None:
-                g.low_q.append(msg)
-                self._low_dirty.add(g.gid)
-            return
-        q = self._cmd_q.get(name)
-        if q is None:
-            self._cmd_q[name] = [msg]
+    def _deliver_internal(self, name: str, msg) -> None:
+        """Self-delivery from the step/egress threads (machine effects
+        re-entering the command queue). Caller holds the state lock;
+        the queue is drained by the next _drain_and_dispatch."""
+        if type(msg) is Command:
+            self._internal_q.append((self._R_CMD, name, msg))
         else:
-            q.append(msg)
+            self._internal_q.append((self._R_MSG, name, None, msg))
 
     def deliver_commands(self, names, cmd: Command) -> None:
         """Bulk ingress for ONE command fanned to many groups (the
         pipelined-bench shape: one wave = the same no-op command to
-        every group leader). One lock round, no per-message tuples or
-        type dispatch — at 10k groups per wave the generic deliver_many
-        tuple stream was a measurable share of the host path."""
-        if cmd.priority == "low":
-            with self._ingress_cv:
-                for name in names:
-                    self._enqueue_cmd(name, None, cmd)
-                self._ingress_cv.notify()
-            return
-        by = self.by_name
-        with self._ingress_cv:
-            cq = self._cmd_q
-            get = cq.get
-            for name in names:
-                q = get(name)
-                if q is None:
-                    if name not in by:
-                        continue
-                    cq[name] = [cmd]
-                else:
-                    q.append(cmd)
-            self._ingress_cv.notify()
+        every group leader). One ring slot for the whole wave; the
+        per-group regrouping runs at drain time on the step thread,
+        off every client lock. ``names`` must not be mutated after the
+        call. Blocks (gate-paced) when the lane is full — the bulk
+        producer is the natural place to absorb backpressure."""
+        self._publish_bulk((self._R_CMDS, names, cmd))
 
     def wal_notify(self, uid: str, evt) -> None:
         """Log-event entry point for WAL / segment-writer notify
@@ -513,9 +633,10 @@ class BatchCoordinator:
         ack it emits is exactly the ack the step-loop path would have
         emitted one wave later."""
         route_out: Dict[str, List] = {}
-        pend: List[Tuple] = []
+        staged = False
         with self._state_lock:
             by_get = self.by_name.get
+            sw = self._staged_written
             for uid, evt in items:
                 g = by_get(uid)
                 if g is None:
@@ -525,7 +646,12 @@ class BatchCoordinator:
                     continue
                 g.log.handle_event(evt)
                 wi, wt = g.log.last_written()
-                pend.append(("w", g.gid, wi))
+                # the device learns the durable watermark at the next
+                # dispatch (the staged written scatter drives the
+                # quorum scan)
+                if sw.get(g.gid, 0) < wi:
+                    sw[g.gid] = wi
+                staged = True
                 if g.pending_ack is not None and wi >= g.pending_ack[1]:
                     leader_sid, cover = g.pending_ack
                     g.pending_ack = None
@@ -540,46 +666,79 @@ class BatchCoordinator:
                                             at if at is not None else wt),
                          (g.name, self.name))
                     )
-            if pend:
-                # the device learns the durable watermark at the next
-                # dispatch (the written scatter drives the quorum scan)
-                self._pending_scatters.extend(pend)
         for node_name, msgs in route_out.items():
             self._send_batch(node_name, msgs)
-        if pend:
-            with self._ingress_cv:
-                self._step_wake = True
-                self._ingress_cv.notify()
+        # wake the step thread only when the staged watermark is
+        # actionable NOW: with a ticket in flight the idle predicate
+        # ignores staged work (an ingest-only pass cannot scatter it),
+        # so an unconditional set here woke the loop for nothing — the
+        # spurious wakeups BENCH_THREADED recorded. When the in-flight
+        # ticket realises, the egress thread's own _have_work check
+        # sees the staged state and wakes the loop (its inflight
+        # decrement precedes that check, so no release is ever missed).
+        if staged and self._have_work() and not self._wake.is_set():
+            self._wake.set()
 
     def deliver_many(self, msgs) -> None:
-        """Batch ingress: one lock round for many ``(to_sid, msg,
-        from_sid)`` triples (unknown group names are dropped, as in
-        ``deliver``)."""
-        by = self.by_name
-        ingress = self._ingress
-        with self._ingress_cv:
-            # _cmd_q must be read under the lock — the step thread swaps
-            # it out during its drain
-            cq = self._cmd_q
-            for to, m, frm in msgs:
-                name = to[0]
-                if type(m) is Command:
-                    # inlined _enqueue_cmd normal path (hot: one call
-                    # per pipelined command); unknown names drop here
-                    # too, matching deliver()
-                    if name not in by:
-                        continue
-                    if m.priority == "low":
-                        self._enqueue_cmd(name, None, m)
-                        continue
-                    q = cq.get(name)
-                    if q is None:
-                        cq[name] = [m]
-                    else:
-                        q.append(m)
-                elif name in by:
-                    ingress.append((name, frm, m))
-            self._ingress_cv.notify()
+        """Batch ingress: ONE ring slot for many ``(to_sid, msg,
+        from_sid)`` triples (unknown group names are dropped at drain,
+        as in ``deliver``). Blocks gate-paced when the lane is full."""
+        triples = [(to[0], frm, m) for to, m, frm in msgs]
+        self._publish_bulk((self._R_BATCH, triples))
+
+    def _publish_bulk(self, item) -> None:
+        """Bulk client publish: keep arrival order (never overtake
+        parked overflow work — the overflow queue folds after the lane
+        drain) WITHOUT giving up pacing. While overflow is pending,
+        gate-wait a bounded window for the drain to clear it; only if
+        it persists does the wave park on the overflow queue too —
+        producers stay paced at the gate cadence instead of appending
+        unbounded waves at line rate (the failure mode an unconditional
+        divert would reintroduce under exactly the overload the bounded
+        rings exist for)."""
+        if self._overflow_q:
+            ident = threading.get_ident()
+            for _ in range(4):
+                if ident in self._drainer_idents or not self.running:
+                    break
+                self._ring_gate.waiter().wait(0.05)
+                if not self._overflow_q:
+                    break
+            if self._overflow_q:
+                self._publish_overflow(item)
+                return
+        if not self._rings.publish(item):
+            self.counters.incr("ingress_ring_full")
+            self._publish_blocking(item)
+
+    def ingest_batch(self, triples) -> int:
+        """Peer-coordinator bulk ingress (the _send_batch fast path):
+        pre-normalized ``(name, from_sid, msg)`` triples, one ring slot
+        per per-node batch. On a full lane the batch SPLITS by the
+        backpressure table: lossy protocol traffic is shed (returns the
+        shed count for the sender's drop accounting), everything else —
+        snapshot chunks/acks, TimeoutNow, client commands, log events —
+        rides the overflow queue (must-deliver: a batch-level drop
+        would stall a snapshot transfer for its whole ack timeout and
+        silently swallow leadership transfers). Returns the number of
+        messages dropped (0 = everything delivered)."""
+        if not self._overflow_q:
+            # (while older must-deliver work is parked on the overflow
+            # queue, a lane publish would overtake it — divert below)
+            if self._rings.publish((self._R_BATCH, triples)):
+                return 0
+            self.counters.incr("ingress_ring_full")
+        must = [t for t in triples if type(t[2]) not in LOSSY_PROTOCOL_TYPES]
+        if must:
+            self._publish_overflow((self._R_BATCH, must))
+        if len(must) == len(triples):
+            return 0
+        # lossy remainder is order-insensitive (sender-retried): it may
+        # still ride the lane; shed only what the lane cannot take
+        lossy = [t for t in triples if type(t[2]) in LOSSY_PROTOCOL_TYPES]
+        if self._rings.publish((self._R_BATCH, lossy)):
+            return 0
+        return len(lossy)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -591,12 +750,27 @@ class BatchCoordinator:
 
     def stop(self) -> None:
         self.running = False
+        self._egress_on = False  # late sends go inline, not to a dead ring
         if self._started:
+            self._wake.set()
+            self._egress_wake.set()
             with self._pipe_cv:
                 self._pipe_cv.notify_all()
             self._step_thread.join(timeout=5)
             if self._egress_thread is not None:
                 self._egress_thread.join(timeout=5)
+            if self._sender_thread is not None:
+                self._sender_thread.join(timeout=5)
+                # a publisher that read _egress_on before stop() flipped
+                # it can land a batch AFTER the sender's final drain:
+                # ship the residue inline so queued acks still leave
+                out: List = []
+                if self._egress_rings.drain(out):
+                    for node_name, msgs in out:
+                        try:
+                            self._send_batch_inline(node_name, msgs)
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
             # join the detector too: a straggling health scan sitting in
             # a device fetch at interpreter exit can crash the XLA
             # runtime's C++ teardown
@@ -758,16 +932,55 @@ class BatchCoordinator:
 
     # -- the step loop -----------------------------------------------------
 
+    def _have_work(self) -> bool:
+        """Is there anything for a step pass to do right now? Fresh
+        ingress (ring items, internal self-deliveries, buffered lows)
+        always counts. Deferred device work — hot gids, staged
+        scatters, queued roles, parked rares — counts only with no
+        ticket in flight: an ingest-only pass cannot act on it, so
+        waiting on it mid-flight would busy-spin until realisation
+        wakes us (its inflight decrement precedes the wake set, so the
+        post-wake re-check sees the dispatchable state)."""
+        if (
+            self._rings.pending() or self._internal_q
+            or self._overflow_q or self._low_dirty
+        ):
+            return True
+        if self._pipe_inflight > 0:
+            return False
+        return bool(
+            self._hot or self._staged_app or self._staged_written
+            or self._pending_roles or self._pending_rare
+        )
+
+    def _idle_wait(self) -> None:
+        """Event-driven idle block (docs/INTERNALS.md §16): clear the
+        wake event, re-check for work (a publish between the last drain
+        and the clear must not be lost — publish stores the item BEFORE
+        setting the event, so either the re-check sees the item or the
+        wait sees the set), then block until a ring publish, WAL
+        notify, egress realisation, timer delivery, or stop wakes us.
+        No timed polls: an idle coordinator consumes zero CPU, and the
+        ``step_spurious_wakeups`` counter proves every wake found
+        work."""
+        wake = self._wake
+        wake.clear()
+        if self._have_work() or not self.running:
+            return
+        wake.wait()
+        self.counters.incr("step_wakeups")
+        if self.running and not self._have_work():
+            self.counters.incr("step_spurious_wakeups")
+
     def _run(self) -> None:
+        self._drainer_idents.add(threading.get_ident())
         if self.pipeline:
             self._run_pipelined()
             return
         while self.running:
             worked = self.step_once()
             if not worked:
-                with self._ingress_cv:
-                    if not (self._ingress or self._cmd_q or self._low_dirty):
-                        self._ingress_cv.wait(timeout=0.05)
+                self._idle_wait()
 
     def _run_pipelined(self) -> None:
         """Two-stage pipelined wave loop (docs/INTERNALS.md §15). This
@@ -788,6 +1001,13 @@ class BatchCoordinator:
             daemon=True,
         )
         self._egress_thread.start()
+        if self._egress_async:
+            self._sender_thread = threading.Thread(
+                target=self._sender_loop, name=f"ra-batch-snd-{self.name}",
+                daemon=True,
+            )
+            self._sender_thread.start()
+            self._egress_on = True
         cv = self._pipe_cv
         while self.running:
             t0 = time.perf_counter_ns()
@@ -799,8 +1019,14 @@ class BatchCoordinator:
             # only incremented by this thread, so a lock-free read of 0
             # is exact (a stale >0 just delays dispatch by one pass).
             inflight = self._pipe_inflight > 0
+            # classify OUTSIDE the state lock (docs/INTERNALS.md §16):
+            # the WAL writer's wal_notify_many must never wait behind
+            # the O(items) classification of a deep burst
+            pre = self._drain_classify()
             with self._state_lock:
-                ticket = self._drain_and_dispatch(dispatch=not inflight)
+                ticket = self._drain_and_dispatch(
+                    dispatch=not inflight, pre=pre
+                )
             if inflight:
                 # host staging done while the previous step's device
                 # compute / egress realisation / WAL handoff were in
@@ -808,6 +1034,27 @@ class BatchCoordinator:
                 dt = time.perf_counter_ns() - t0
                 if dt > 20_000:  # ignore empty probe passes
                     self.counters.incr("pipeline_overlap_ns", dt)
+                # double-buffered staging: pre-zero the NEXT dispatch's
+                # full-width mailbox inside the overlap window, so the
+                # dispatching pass packs into a ready spare with zero
+                # take/zero cost on its critical path
+                if self._prezero_useful and self._spare_mbox is None:
+                    with self._state_lock:
+                        buf = None
+                        pool = self._mbox_pool
+                        for k, b in enumerate(pool):
+                            if b.shape[1] == self.capacity:
+                                buf = b
+                                del pool[k]
+                                break
+                    if buf is None:
+                        buf = np.zeros(
+                            (self._NROWS, self.capacity), np.int32
+                        )
+                    else:
+                        buf.fill(0)
+                    self._spare_mbox = buf
+                    self.counters.incr("staging_prezeroed")
             if ticket is not None:
                 self.counters.incr("pipeline_steps")
                 with cv:
@@ -815,34 +1062,18 @@ class BatchCoordinator:
                     self._pipe_q.append(ticket)
                     cv.notify_all()
                 continue
-            with self._ingress_cv:
-                if self._pipe_inflight > 0:
-                    # deferred device work (_hot, queued scatters) can
-                    # only be acted on by a dispatching pass — waiting
-                    # on it here would busy-spin until realisation
-                    # finishes (its _step_wake is the wake signal)
-                    if not (
-                        self._step_wake or self._ingress or self._cmd_q
-                        or self._low_dirty
-                    ):
-                        self._ingress_cv.wait(timeout=0.05)
-                elif not (
-                    self._step_wake
-                    or self._ingress or self._cmd_q or self._low_dirty
-                    or self._hot or self._pending_scatters
-                    or self._pending_roles
-                ):
-                    self._ingress_cv.wait(timeout=0.05)
-                self._step_wake = False
+            self._idle_wait()
         with cv:
             cv.notify_all()
+        self._egress_wake.set()
 
     def _egress_loop(self) -> None:
+        self._drainer_idents.add(threading.get_ident())
         cv = self._pipe_cv
         while True:
             with cv:
                 while not self._pipe_q and self.running:
-                    cv.wait(timeout=0.05)
+                    cv.wait()
                 if not self._pipe_q:
                     return  # stopped and drained
                 ticket = self._pipe_q.popleft()
@@ -857,10 +1088,73 @@ class BatchCoordinator:
                 self._pipe_inflight -= 1
                 cv.notify_all()
             # realisation may have produced device work (hot retries,
-            # queued scatters): wake the step thread if it went idle
-            with self._ingress_cv:
-                self._step_wake = True
-                self._ingress_cv.notify()
+            # staged scatters) or unblocked deferred work the idle
+            # predicate ignores while a ticket is in flight: wake the
+            # step thread ONLY when such work exists — an unconditional
+            # wake after a work-free realisation is exactly the
+            # spurious wakeup the idle invariant forbids (caught by
+            # test_command_plane's zero-spurious assertion). The
+            # inflight decrement above precedes the check, so the
+            # deferred state is dispatchable by the time we look; any
+            # work arriving after a negative check sets the wake
+            # itself (publish/stage/notify all do).
+            if self._have_work() and not self._wake.is_set():
+                self._wake.set()
+
+    def _sender_loop(self) -> None:
+        """Dedicated egress fan-out thread: per-destination message
+        batches handed off through a bounded ring by the step/egress/
+        WAL threads are shipped here, off every latency-critical loop.
+        Drains outstanding batches on stop so queued acks still leave."""
+        wake = self._egress_wake
+        rings = self._egress_rings
+        out: List = []
+        while True:
+            n = rings.drain(out)
+            if not n:
+                wake.clear()
+                if rings.pending():
+                    continue
+                if not self.running:
+                    # straggler window: a publisher that read _egress_on
+                    # just before stop() flipped it may land a batch
+                    # after this empty check — give it one short beat,
+                    # re-drain, and let stop()'s post-join residual
+                    # drain catch anything even later
+                    time.sleep(0.01)
+                    if rings.pending():
+                        continue
+                    return
+                wake.wait()
+                continue
+            msgs_n = 0
+            for node_name, msgs in out:
+                try:
+                    self._send_batch_inline(node_name, msgs)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "coordinator %s: egress sender batch to %s failed",
+                        self.name, node_name,
+                    )
+                msgs_n += len(msgs)
+            self.counters.incr("egress_thread_batches", n)
+            self.counters.incr("egress_thread_msgs", msgs_n)
+            out.clear()
+
+    def _coop_drainer(self):
+        """Register the calling thread as a drainer for the span of one
+        cooperative step_* call (its self-publishes divert to
+        ``_internal_q`` instead of gate-waiting on a ring it is itself
+        responsible for draining). Returns a token for ``_coop_done``."""
+        ident = threading.get_ident()
+        if ident in self._drainer_idents:
+            return 0
+        self._drainer_idents.add(ident)
+        return ident
+
+    def _coop_done(self, token: int) -> None:
+        if token:
+            self._drainer_idents.discard(token)
 
     def step_once(self) -> bool:
         """One SEQUENTIAL coordinator iteration: drain ingress, scatter
@@ -868,6 +1162,14 @@ class BatchCoordinator:
         Returns False when there was nothing to do. Deterministic-test
         and cooperative-driver entry point — never call it on a started
         pipelined coordinator (realisation order would invert)."""
+        token = self._coop_drainer()
+        try:
+            return self._step_once_inner()
+        finally:
+            self._coop_done(token)
+
+    def _step_once_inner(self) -> bool:
+        pre = self._drain_classify()  # heavy half, off the state lock
         with self._state_lock:
             prev = self._coop_ticket
             if prev is not None:
@@ -879,8 +1181,11 @@ class BatchCoordinator:
                     if prev.eg_packed is not None else None
                 )
                 self._finish_ticket(prev, eg_np)
+                # the pre-drained items are NOT lost: hand them to the
+                # dispatch pass the driver's next call runs
+                self._drain_and_dispatch(dispatch=False, pre=pre)
                 return True
-            ticket = self._drain_and_dispatch()
+            ticket = self._drain_and_dispatch(pre=pre)
             if ticket is None:
                 return False
             eg_np = (
@@ -898,6 +1203,14 @@ class BatchCoordinator:
         finishes every coordinator — each device step then computes
         while the driver stages the others (the single-thread form of
         the wave pipeline, docs/INTERNALS.md §15)."""
+        token = self._coop_drainer()
+        try:
+            return self._step_stage_inner()
+        finally:
+            self._coop_done(token)
+
+    def _step_stage_inner(self) -> bool:
+        pre = self._drain_classify()  # heavy half, off the state lock
         with self._state_lock:
             prev = self._coop_ticket
             if prev is not None:
@@ -908,7 +1221,7 @@ class BatchCoordinator:
                     if prev.eg_packed is not None else None
                 )
                 self._finish_ticket(prev, eg_np)
-            ticket = self._drain_and_dispatch()
+            ticket = self._drain_and_dispatch(pre=pre)
             self._coop_ticket = ticket
             return ticket is not None
 
@@ -916,6 +1229,13 @@ class BatchCoordinator:
         """Cooperative-pipeline half B: realise the ticket parked by
         ``step_stage`` (egress sync + processing + commit-driven AERs).
         Counts the staged-while-in-flight overlap."""
+        token = self._coop_drainer()
+        try:
+            return self._step_finish_inner()
+        finally:
+            self._coop_done(token)
+
+    def _step_finish_inner(self) -> bool:
         with self._state_lock:
             ticket = self._coop_ticket
             if ticket is None:
@@ -949,6 +1269,14 @@ class BatchCoordinator:
         fan-out never waits a pipeline slot. Same ticket machinery as
         the threaded loop; keep calling until False before reading
         final state, and do not mix with a started loop."""
+        token = self._coop_drainer()
+        try:
+            return self._step_pipelined_inner()
+        finally:
+            self._coop_done(token)
+
+    def _step_pipelined_inner(self) -> bool:
+        pre = self._drain_classify()  # heavy half, off the state lock
         with self._state_lock:
             prev = self._coop_ticket
             self._coop_ticket = None
@@ -959,7 +1287,7 @@ class BatchCoordinator:
                 )
                 self._finish_ticket(prev, eg_np)
             t0 = time.perf_counter_ns()
-            ticket = self._drain_and_dispatch()
+            ticket = self._drain_and_dispatch(pre=pre)
             self._coop_ticket = ticket
             if ticket is not None and prev is not None:
                 # staged+dispatched in the same round a previous step
@@ -983,56 +1311,192 @@ class BatchCoordinator:
             for k in self.__slots__:
                 setattr(self, k, kw.get(k))
 
-    def _drain_and_dispatch(
-        self, dispatch: bool = True
-    ) -> Optional["BatchCoordinator._StepTicket"]:
+    def _drain_classify(self):
+        """Lock-FREE half of the drain (docs/INTERNALS.md §16): pop
+        every ingress lane into the reusable scratch and classify in
+        one pass — commands regroup per target, generic messages
+        collect into a route list, low-priority commands set aside.
+        Runs on the step/driver thread WITHOUT the state lock: the
+        classification of a deep-pipelined burst is O(items) pure
+        Python, and holding the state lock through it starved the WAL
+        writer's ``wal_notify_many`` (measured: 4x total fsync time,
+        p99 8 ms -> 150 ms at 10240x96 — the writer blocked behind the
+        lock, its queue grew, and every later batch paid the backlog).
+        Only ``by_name`` reads happen here (GIL-safe dict reads; a
+        concurrently added group at worst misses one pass, the same
+        contract ``deliver`` already has). Returns the pre-drain
+        ``(t_in, n_items, cmd_q, routes, lows)`` consumed by
+        ``_drain_and_dispatch`` under the lock."""
         _t_in = time.perf_counter_ns()
-        with self._ingress_cv:
-            batch = list(self._ingress)
-            self._ingress.clear()
-            cmd_q = self._cmd_q
-            if cmd_q:
-                self._cmd_q = {}
-            else:
-                # never keep an alias of the LIVE (empty) dict — a
-                # concurrent deliver would fill it and the next drain
-                # would double-process those commands
-                cmd_q = None
+        buf = self._drain_buf
+        n_items = self._rings.drain(buf)
+        if self._overflow_q:
+            # overflow items are NEWER than the ring contents drained
+            # above (a publish only overflows while the lane is full of
+            # its own earlier items), so they fold AFTER the lane
+            # drain; cross-pass order is kept by the producer-side
+            # divert (a must-deliver publish goes straight to overflow
+            # while older overflow is still parked — see deliver/
+            # ingest_batch)
+            with self._overflow_lock:
+                n_items += len(self._overflow_q)
+                buf.extend(self._overflow_q)
+                self._overflow_q.clear()
+        cmd_q: Optional[Dict[str, List[Command]]] = None
+        routes: Optional[List] = None
+        lows: Optional[List] = None
+        if buf:
+            cmd_q = {}
+            routes = []
+            lows = []
+            radd = routes.append
+            by = self.by_name
+            cq_get = cmd_q.get
+            R_MSG, R_CMD, R_CMDS = self._R_MSG, self._R_CMD, self._R_CMDS
+            for item in buf:
+                tag = item[0]
+                if tag == R_CMD:
+                    _, name, cmd = item
+                    if name not in by:
+                        continue
+                    if cmd.priority == "low":
+                        lows.append((name, cmd))
+                        continue
+                    q = cq_get(name)
+                    if q is None:
+                        cmd_q[name] = [cmd]
+                    else:
+                        q.append(cmd)
+                elif tag == R_MSG:
+                    _, name, from_sid, msg = item
+                    if name in by:
+                        radd((name, from_sid, msg))
+                elif tag == R_CMDS:
+                    _, names, cmd = item
+                    if cmd.priority == "low":
+                        for name in names:
+                            if name in by:
+                                lows.append((name, cmd))
+                        continue
+                    for name in names:
+                        q = cq_get(name)
+                        if q is None:
+                            if name not in by:
+                                continue
+                            cmd_q[name] = [cmd]
+                        else:
+                            q.append(cmd)
+                else:  # R_BATCH: pre-normalized (name, from_sid, msg)
+                    for trip in item[1]:
+                        name = trip[0]
+                        msg = trip[2]
+                        if type(msg) is Command:
+                            if msg.priority == "low":
+                                if name in by:
+                                    lows.append((name, msg))
+                                continue
+                            q = cq_get(name)
+                            if q is None:
+                                if name not in by:
+                                    continue
+                                cmd_q[name] = [msg]
+                            else:
+                                q.append(msg)
+                        elif name in by:
+                            radd(trip)
+            buf.clear()
+        if n_items:
+            self.counters.incr("ingress_ring_msgs", n_items)
+            self.counters.incr("ingress_ring_drains")
+            # space was freed on every lane: wake ring-full waiters
+            self._ring_gate.open()
+        return (_t_in, n_items, cmd_q, routes, lows)
+
+    def _drain_and_dispatch(
+        self, dispatch: bool = True, pre=None
+    ) -> Optional["BatchCoordinator._StepTicket"]:
+        # caller holds the state lock; ``pre`` is _drain_classify()'s
+        # output taken BEFORE the lock (drivers pre-classify so the
+        # heavy classification never blocks the WAL writer). A None pre
+        # classifies inline (tests / direct step calls).
+        if pre is None:
+            pre = self._drain_classify()
+        _t_in, n_items, cmd_q, routes, lows = pre
+        # fold the step/egress threads' own must-deliver self-publishes
+        # (machine Append/Aux effects realized under the state lock —
+        # including by the prev-ticket finish that just ran): they are
+        # few, and folding here keeps their same-pass ordering
+        if self._internal_q:
+            iq = self._internal_q
+            R_CMD = self._R_CMD
+            if cmd_q is None:
+                cmd_q, routes, lows = {}, [], []
+            by = self.by_name
+            n_internal = 0
+            while iq:
+                item = iq.popleft()
+                n_internal += 1
+                if item[0] == R_CMD:
+                    _, name, cmd = item
+                    if name not in by:
+                        continue
+                    if cmd.priority == "low":
+                        lows.append((name, cmd))
+                        continue
+                    q = cmd_q.get(name)
+                    if q is None:
+                        cmd_q[name] = [cmd]
+                    else:
+                        q.append(cmd)
+                else:
+                    _, name, from_sid, msg = item
+                    if name in by:
+                        routes.append((name, from_sid, msg))
+            # counted in n_items (pass-has-work accounting) but NOT in
+            # ingress_ring_msgs — these never touched a ring
+            n_items += n_internal
         # seed rares / AER-dirty gids parked by earlier ingest-only
-        # passes (pipelined loop); appended/written runs they drained
-        # are already in _pending_scatters
-        # ALWAYS detach (same trap as cmd_q above): _route_one appends
-        # into these, so keeping an alias of the live (empty) container
-        # would re-seed — and re-process — this pass's rares/AER gids
-        # on the next pass
+        # passes (pipelined loop).
+        # ALWAYS detach (aliasing trap): _route_one appends into it,
+        # so keeping an alias of the live (empty) container would
+        # re-seed — and re-process — this pass's rares on the next pass
         rare: List[Tuple[GroupHost, Any, Optional[ServerId]]] = (
             self._pending_rare
         )
         self._pending_rare = []
-        aer_dirty: set = self._pending_aer
-        self._pending_aer = set()
+        aer_dirty: set = set()
         # appended runs: gid -> [[lo, hi, term], ...] (contiguous,
         # same-term); written: gid -> max durable idx. Run-based so the
         # device scatter is one row per touched GROUP, not per entry.
-        appended: Dict[int, List[List[int]]] = {}
-        written: Dict[int, int] = {}
+        # These ARE the staged double-buffer halves: ingest-only passes
+        # leave their folds in place and the next dispatching pass
+        # consumes them with zero re-merging (the WAL writer thread
+        # stages durable watermarks into _staged_written directly).
+        appended = self._staged_app
+        written = self._staged_written
         # replies produced during routing (deferred durable acks): one
         # transport hop per destination per step, not one per group
         route_out: Dict[str, List] = {}
 
         by_get = self.by_name.get
         route = self._route_one
-        now_mono = time.monotonic() if batch else 0.0
-        for to_name, from_sid, msg in batch:
-            g = by_get(to_name)
-            if g is None:
-                continue
-            route(g, from_sid, msg, rare, appended, written, aer_dirty,
-                  route_out, now_mono)
+        if lows:
+            low_dirty = self._low_dirty
+            for name, cmd in lows:
+                g = by_get(name)
+                if g is not None:
+                    g.low_q.append(cmd)
+                    low_dirty.add(g.gid)
+        if routes:
+            now_mono = time.monotonic()
+            for name, from_sid, msg in routes:
+                g = by_get(name)
+                if g is not None:
+                    route(g, from_sid, msg, rare, appended, written,
+                          aer_dirty, route_out, now_mono)
         if route_out:
             for node_name, msgs in route_out.items():
                 self._send_batch(node_name, msgs)
-        # commands were pre-grouped per target at delivery time
         if cmd_q:
             for name, cmds in cmd_q.items():
                 g = by_get(name)
@@ -1042,25 +1506,19 @@ class BatchCoordinator:
             self._drain_low_lane(appended, written, aer_dirty)
 
         if not dispatch:
-            # ingest-only pass (a ticket is still being realised): fold
-            # everything drained into the pending state the next
-            # dispatching pass picks up. Commands have already reached
-            # the logs and the WAL queue — the coalescing the pipeline
-            # is for happens here.
-            if appended or written:
-                pend = self._pending_scatters
-                for gid, runs in appended.items():
-                    for lo, hi, term in runs:
-                        pend.append(("a", gid, lo, hi, term))
-                for gid, idx in written.items():
-                    pend.append(("w", gid, idx))
+            # ingest-only pass (a ticket is still being realised): the
+            # drained work is already folded into the staged scatter
+            # dicts the next dispatching pass consumes, and commands
+            # have already reached the logs and the WAL queue — the
+            # coalescing the pipeline is for happens here.
             if rare:
                 self._pending_rare = rare
             if aer_dirty:
                 # replication fan-out never waits for the next dispatch:
                 # fresh appends ship while the in-flight step realises
                 self._send_aers(aer_dirty)
-            if batch or cmd_q:
+            if n_items:
+                self.counters.incr("staging_passes")
                 _t_drain = time.perf_counter_ns()
                 self._wave_h["ingress_drain"].record(_t_drain - _t_in)
                 if self._trace.enabled:
@@ -1068,8 +1526,8 @@ class BatchCoordinator:
                                      _t_drain - _t_in)
             return None
         if not (
-            batch or cmd_q or self._hot or rare or appended or written
-            or self._pending_scatters or self._pending_roles
+            n_items or self._hot or rare or appended or written
+            or self._pending_roles
         ):
             return None
         _t_drain = time.perf_counter_ns()
@@ -1081,19 +1539,11 @@ class BatchCoordinator:
             self._pending_roles = []
             self.state = C.set_roles(self.state, gids, roles)
 
-        for item in self._pending_scatters:
-            if item[0] == "a":
-                _, gid, lo, hi, term = item
-                runs = appended.setdefault(gid, [])
-                if runs and runs[-1][1] + 1 == lo and runs[-1][2] == term:
-                    runs[-1][1] = hi
-                else:
-                    runs.append([lo, hi, term])
-            else:
-                _, gid, idx = item
-                if written.get(gid, 0) < idx:
-                    written[gid] = idx
-        self._pending_scatters = []
+        # consume the staged halves: detach so concurrent stagers (the
+        # WAL writer thread, the egress thread's rare paths) start a
+        # fresh buffer for the NEXT dispatch
+        self._staged_app = {}
+        self._staged_written = {}
 
         app_rows: List[Tuple[int, int, int, int]] = []
         if appended:
@@ -1181,6 +1631,10 @@ class BatchCoordinator:
             stepped = True
             self.steps += 1
             self.msgs_processed += len(consumed)
+        # full-width steps are the shape worth pre-zeroing a spare
+        # mailbox for during the next overlap window (sub-batch buffers
+        # are tiny; zeroing them inline is already free)
+        self._prezero_useful = stepped and act is None
         _t_pack = time.perf_counter_ns()
         # dispatch is ASYNC: eg_packed is an in-flight device value; the
         # ticket's realisation half syncs it (np.asarray) and processes
@@ -1230,6 +1684,13 @@ class BatchCoordinator:
             eg = {name: eg_np[i] for i, name in enumerate(C.EGRESS_FIELDS)}
             self._process_egress(eg, ticket.consumed, aer_dirty,
                                  act=ticket.act)
+        # rare-path outbound batches per destination ACROSS the whole
+        # rare loop: an election storm over 10k groups must land on a
+        # peer as a handful of ring items, not one per group — per-group
+        # sends overflowed the peer's bounded ingress lane and the
+        # overflow was shed as lossy traffic, wedging the un-retried
+        # tail of the storm (caught by the 10240-group bench election)
+        rare_out: Dict[str, List] = {}
         for g, msg, from_sid in ticket.rare:
             # crash isolation for the slow paths (snapshot transfer
             # decode of untrusted bytes, membership, queries): a
@@ -1237,16 +1698,21 @@ class BatchCoordinator:
             # group on this coordinator would freeze (the actor backend
             # gets the same guarantee from scheduler crash isolation)
             try:
-                self._handle_rare(g, msg, from_sid)
+                self._handle_rare(g, msg, from_sid, rare_out)
             except Exception:  # noqa: BLE001
                 logger.exception(
                     "coordinator %s: dropping rare message %r for group "
                     "%s after handler crash", self.name, type(msg).__name__,
                     g.name,
                 )
+        for node_name, msgs in rare_out.items():
+            self._send_batch(node_name, msgs)
         _t_eg = time.perf_counter_ns()
         self._send_aers(aer_dirty)
         _t_aer = time.perf_counter_ns()
+        # apply progress may have released admission-window room: wake
+        # parked rejected clients (no-op attribute check when none)
+        self._adm_gate.open()
         # per-step wave-phase breakdown (obs.WAVE_PHASES). host_pack
         # covered queued-scatter application + mailbox build + dispatch
         # (recorded at dispatch time); device_step is the egress host
@@ -1268,6 +1734,23 @@ class BatchCoordinator:
                         _t_dev - ticket.t_pack)
                 tb.span("host_egress", node, _t_dev, _t_eg - _t_dev)
             tb.span("aer_fanout", node, _t_eg, _t_aer - _t_eg)
+
+    def _stage_app(self, gid: int, lo: int, hi: int, term: int) -> None:
+        """Stage an appended run for the next dispatching pass's device
+        scatter (caller holds the state lock). Contiguous same-term runs
+        merge in place — the staging half of the double buffer."""
+        runs = self._staged_app.get(gid)
+        if runs is None:
+            self._staged_app[gid] = [[lo, hi, term]]
+        elif runs[-1][1] + 1 == lo and runs[-1][2] == term:
+            runs[-1][1] = hi
+        else:
+            runs.append([lo, hi, term])
+
+    def _stage_written(self, gid: int, idx: int) -> None:
+        """Stage a durable watermark (caller holds the state lock)."""
+        if self._staged_written.get(gid, 0) < idx:
+            self._staged_written[gid] = idx
 
     def _pad(self, rows, width: int):
         """Pad scatter batches to power-of-two buckets so XLA compiles a
@@ -1376,46 +1859,33 @@ class BatchCoordinator:
         normal ingest always goes first; lows trickle in slices so a
         low-priority firehose cannot starve interactive traffic
         (reference: ra_ets_queue lane, src/ra_server_proc.erl:507-530).
-        Non-leaders redirect buffered lows instead of dropping futures."""
-        with self._ingress_cv:
-            # delivery threads add to _low_dirty under this lock; swap
-            # it out so iteration never races a concurrent add
-            dirty = self._low_dirty
-            self._low_dirty = set()
+        Non-leaders redirect buffered lows instead of dropping futures.
+        Low-priority routing now happens at ring-drain time on the step
+        thread (under the state lock), so ``low_q``/``_low_dirty`` have
+        a single writer and need no extra lock."""
+        dirty = self._low_dirty
+        self._low_dirty = set()
         still: set = set()
         for gid in dirty:
             g = self.groups[gid]
-            if g is None:
+            if g is None or not g.low_q:
                 continue
-            # pop under the ingress lock — delivery threads append to
-            # low_q under it; replies/appends happen outside
-            with self._ingress_cv:
-                if not g.low_q:
-                    continue
-                if g.role != C.R_LEADER:
-                    drained = list(g.low_q)
-                    g.low_q.clear()
-                    take = None
-                else:
-                    drained = None
-                    take = [
-                        g.low_q.popleft()
-                        for _ in range(
-                            min(self.FLUSH_COMMANDS_SIZE, len(g.low_q))
-                        )
-                    ]
-                    if g.low_q:
-                        still.add(gid)
-            if drained is not None:
+            if g.role != C.R_LEADER:
                 red = ("redirect", g.sid_of(g.leader_slot))
-                for cmd in drained:
+                for cmd in g.low_q:
                     if cmd.from_ref is not None:
                         self._reply(cmd.from_ref, red)
-            else:
-                self._handle_commands(g, take, appended, written, aer_dirty)
+                g.low_q.clear()
+                continue
+            take = [
+                g.low_q.popleft()
+                for _ in range(min(self.FLUSH_COMMANDS_SIZE, len(g.low_q)))
+            ]
+            if g.low_q:
+                still.add(gid)
+            self._handle_commands(g, take, appended, written, aer_dirty)
         if still:
-            with self._ingress_cv:
-                self._low_dirty |= still
+            self._low_dirty |= still
 
     def _handle_commands(self, g: GroupHost, cmds, appended, written, aer_dirty):
         """Append a batch of client commands for one group: one pass of
@@ -1458,7 +1928,14 @@ class BatchCoordinator:
             for cmd in shed:
                 if cmd.from_ref is not None:
                     n_rej += 1
-                    self._reply(cmd.from_ref, ("reject", "overloaded"))
+                    # the reject carries an admission-gate waiter:
+                    # api.process_command parks on it and is WOKEN on
+                    # window release (apply progress) instead of
+                    # sleeping a fixed backoff (docs/INTERNALS.md §16)
+                    self._reply(
+                        cmd.from_ref,
+                        REJECT_OVERLOADED + (self._adm_gate.waiter(),),
+                    )
             if n_rej:
                 self.counters.incr("commands_rejected", n_rej)
             if len(shed) > n_rej:
@@ -1705,6 +2182,12 @@ class BatchCoordinator:
         empty — pool size is bounded by the tickets in flight."""
         if width is None:
             width = self.capacity
+            spare = self._spare_mbox
+            if spare is not None:
+                # double-buffered staging: the spare was pre-zeroed in
+                # the pipeline overlap window — no take/zero cost here
+                self._spare_mbox = None
+                return spare
         pool = self._mbox_pool
         for k, buf in enumerate(pool):
             if buf.shape[1] == width:
@@ -2161,23 +2644,22 @@ class BatchCoordinator:
             # are monotonic, so equal first/last terms mean ONE run —
             # the per-entry split loop only runs for term-crossing
             # batches (rare: a new leader resending mixed history)
-            pend = self._pending_scatters
             first = to_write[0]
             last = to_write[-1]
             if first.term == last.term:
-                pend.append(("a", g.gid, first.index, last.index, first.term))
+                self._stage_app(g.gid, first.index, last.index, first.term)
             else:
                 lo = prev = first.index
                 term = first.term
                 for e in to_write[1:]:
                     if e.term != term:
-                        pend.append(("a", g.gid, lo, prev, term))
+                        self._stage_app(g.gid, lo, prev, term)
                         lo, term = e.index, e.term
                     prev = e.index
-                pend.append(("a", g.gid, lo, prev, term))
+                self._stage_app(g.gid, lo, prev, term)
             wi, _ = g.log.last_written()
             if wi >= to_write[-1].index:
-                pend.append(("w", g.gid, wi))
+                self._stage_written(g.gid, wi)
 
     def _ack_aer(self, g: GroupHost, from_sid, msg: AppendEntriesRpc, term, outbound):
         """Success ack with the host's durable watermark, anchored to
@@ -2230,10 +2712,10 @@ class BatchCoordinator:
         g.noop_index = idx
         g.noop_committed = False
         g.cluster_change_permitted = False
-        self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
+        self._stage_app(g.gid, idx, idx, g.term)
         wi, _ = g.log.last_written()
         if wi >= idx:
-            self._pending_scatters.append(("w", g.gid, wi))
+            self._stage_written(g.gid, wi)
         aer_dirty.add(g.gid)
 
     def _apply_group(self, g: GroupHost, commit_index: int) -> None:
@@ -2454,13 +2936,15 @@ class BatchCoordinator:
                 entries = g.log.sparse_read(list(eff.indexes))
                 out = eff.fn(entries)
                 if out is not None:
-                    self.deliver((g.name, self.name), out, None)
+                    # apply runs on a drainer thread under the state
+                    # lock: self-deliveries ride the internal queue
+                    # straight into the next drain (never the rings —
+                    # a full lane must not block the drainer on itself)
+                    self._deliver_internal(g.name, out)
             elif isinstance(eff, fx.Reply):
                 self._reply(eff.from_ref, eff.reply)
             elif isinstance(eff, fx.Aux):
-                self.deliver(
-                    (g.name, self.name), ("aux", "cast", eff.cmd, None), None
-                )
+                self._deliver_internal(g.name, ("aux", "cast", eff.cmd, None))
             elif isinstance(eff, (fx.Append, fx.TryAppend)):
                 # machine-originated command re-enters via the command
                 # queue: the next step's drain appends it on the leader;
@@ -2469,13 +2953,12 @@ class BatchCoordinator:
                 # Only the leader's copy carries the reply ref — every
                 # replica realises a TryAppend, and a follower's
                 # redirect must not race the leader's ok on one future
-                self.deliver(
-                    (g.name, self.name),
+                self._deliver_internal(
+                    g.name,
                     Command(kind=USR, data=eff.cmd,
                             reply_mode=eff.reply_mode,
                             from_ref=eff.from_ref if is_leader else None,
                             internal=True),
-                    None,
                 )
 
     def _sync_snapshot_floor(self, g: GroupHost) -> None:
@@ -2560,6 +3043,18 @@ class BatchCoordinator:
             fut(value)
 
     def _send_batch(self, node_name: str, msgs) -> None:
+        """Per-destination batch send. With the started pipelined loop,
+        the fan-out hands off to the dedicated sender thread through a
+        bounded ring — the step/egress/WAL threads never pay transport
+        cost; a full handoff ring falls back to an inline send (bounded
+        handoff never drops)."""
+        if self._egress_on:
+            if self._egress_rings.publish((node_name, msgs)):
+                return
+            self.counters.incr("egress_thread_ring_full")
+        self._send_batch_inline(node_name, msgs)
+
+    def _send_batch_inline(self, node_name: str, msgs) -> None:
         node = self.registry.get(node_name)
         if node is None:
             return
@@ -2570,18 +3065,20 @@ class BatchCoordinator:
                 self.transport.dropped += len(msgs)
                 return
             drop = self.transport.drop_fn
-            with node._ingress_cv:
-                if drop is None:
-                    node._ingress.extend(
-                        (to[0], frm, msg) for to, msg, frm in msgs
-                    )
-                else:
-                    for to, msg, frm in msgs:
-                        if drop(to, msg):
-                            self.transport.dropped += 1
-                            continue
-                        node._ingress.append((to[0], frm, msg))
-                node._ingress_cv.notify()
+            if drop is None:
+                triples = [(to[0], frm, msg) for to, msg, frm in msgs]
+            else:
+                triples = []
+                for to, msg, frm in msgs:
+                    if drop(to, msg):
+                        self.transport.dropped += 1
+                    else:
+                        triples.append((to[0], frm, msg))
+            if triples:
+                # peer's ingress lane full: the peer sheds only the
+                # lossy subset (counted here) and overflow-queues the
+                # must-deliver remainder — never a batch-level drop
+                self.transport.dropped += node.ingest_batch(triples)
             return
         for to, msg, frm in msgs:
             self.transport.send(to, msg, from_sid=frm)
@@ -2735,7 +3232,14 @@ class BatchCoordinator:
 
     # -- rare paths --------------------------------------------------------
 
-    def _handle_rare(self, g: GroupHost, msg, from_sid) -> None:
+    def _handle_rare(self, g: GroupHost, msg, from_sid,
+                     rare_out: Optional[Dict[str, List]] = None) -> None:
+        """``rare_out``: the realisation pass's shared per-destination
+        outbound — fan-outs append into it and the caller ships ONE
+        batch per destination after the whole rare loop (a per-group
+        send per election would overflow a peer's bounded ingress lane
+        under a 10k-group storm). A None caller (direct invocations in
+        tests) ships inline."""
         if isinstance(msg, ElectionTimeout):
             if g.role == C.R_LEADER:
                 return
@@ -2761,14 +3265,17 @@ class BatchCoordinator:
             self._hot.add(g.gid)  # force steps so the election progresses
             if len(g.members) == 1:
                 return  # the next device steps self-elect
-            outbound: Dict[str, List] = {}
+            outbound: Dict[str, List] = (
+                {} if rare_out is None else rare_out
+            )
 
             def queue_send(to, m, frm):
                 outbound.setdefault(to[1], []).append((to, m, frm))
 
             self._broadcast_vote_req(g, queue_send, pre=True)
-            for node_name, msgs in outbound.items():
-                self._send_batch(node_name, msgs)
+            if rare_out is None:
+                for node_name, msgs in outbound.items():
+                    self._send_batch(node_name, msgs)
             return
         if isinstance(msg, tuple) and msg and msg[0] == "local_query":
             _, fn, fut = msg
@@ -2796,14 +3303,17 @@ class BatchCoordinator:
                 self.state, jnp.asarray([g.gid], jnp.int32)
             )
             self._hot.add(g.gid)  # keep stepping (single-member self-election)
-            outbound2: Dict[str, List] = {}
+            outbound2: Dict[str, List] = (
+                {} if rare_out is None else rare_out
+            )
 
             def queue_send2(to, m, frm):
                 outbound2.setdefault(to[1], []).append((to, m, frm))
 
             self._broadcast_vote_req(g, queue_send2, pre=False)
-            for node_name, msgs in outbound2.items():
-                self._send_batch(node_name, msgs)
+            if rare_out is None:
+                for node_name, msgs in outbound2.items():
+                    self._send_batch(node_name, msgs)
             return
         if isinstance(msg, tuple) and msg and msg[0] == "transfer_leadership":
             _, target, fut = msg
@@ -2918,7 +3428,7 @@ class BatchCoordinator:
             g.log.append(Entry(index=idx, term=g.term, cmd=Command(
                 kind="ra_cluster_change", data=("replace", ((me, "voter"),)))))
             g.specials.append(idx)
-            self._pending_scatters.append(("a", g.gid, idx, idx, g.term))
+            self._stage_app(g.gid, idx, idx, g.term)
             g.members = [me]
             g.self_slot = 0
             g.next_index = [idx + 1]
@@ -3348,6 +3858,15 @@ class BatchCoordinator:
                             max(0, applied_total - prev[1]), now0 - prev[0]
                         )
                         self.counters.put("commit_rate", int(round(rate)))
+                    # reclaim lanes of exited producer threads, then
+                    # publish the registered-lane gauge (one lane per
+                    # live producer; off the hot drain path)
+                    prune = getattr(self._rings, "prune_dead", None)
+                    if prune is not None:
+                        prune()
+                    self.counters.put(
+                        "ingress_ring_lanes", self._rings.lanes()
+                    )
                     self._health_scan(now0)
                     ms = int(time.time() * 1000)
                     for i in range(self.n_groups):

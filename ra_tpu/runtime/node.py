@@ -130,6 +130,12 @@ class RaNode:
             group_commit_min_gain=self.config.wal_group_commit_min_gain,
         )
         self.wal.fault_scope = name
+        # bulk written-event channel (docs/INTERNALS.md §16): one
+        # callback per fsync batch, fanned to the server actors in one
+        # pass — the actor-backend mirror of the batch coordinator's
+        # wal_notify_many handoff (acks ride the WAL writer thread,
+        # never a per-writer callback loop through the Wal)
+        self.wal.notify_many = self._log_notify_many
         self.wal.on_failure = self._on_wal_failure
         # supervision intensity accounting (see SystemConfig
         # infra_restart_intensity): restart episodes stamped here; when
@@ -561,6 +567,22 @@ class RaNode:
         proc = self.procs.get(name)
         if proc is not None:
             proc.enqueue(LogEvent(evt))
+
+    def _log_notify_many(self, items: List[Tuple[str, Any]]) -> None:
+        """Bulk WAL written-event fan-out: ONE call per fsync batch
+        (the Wal emits at most one written event per writer per batch),
+        enqueued to the server actors in a single pass on the WAL
+        writer thread — durable acks leave without re-entering any
+        shared queue (docs/INTERNALS.md §16)."""
+        name_of = self.directory.name_of
+        procs = self.procs
+        for uid, evt in items:
+            name = name_of(uid)
+            if name is None:
+                continue
+            proc = procs.get(name)
+            if proc is not None:
+                proc.enqueue(LogEvent(evt))
 
     # ------------------------------------------------------------------
     # client plumbing

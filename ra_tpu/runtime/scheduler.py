@@ -113,7 +113,10 @@ class Scheduler:
         while True:
             with self._cv:
                 while not self._queue and not self._closed:
-                    self._cv.wait(timeout=0.5)
+                    # event-driven idle: _submit notifies per enqueue,
+                    # close() notifies all — idle scheduler workers
+                    # consume zero CPU (docs/INTERNALS.md §16)
+                    self._cv.wait()
                 if self._closed:
                     return
                 actor = self._queue.popleft()

@@ -243,6 +243,11 @@ class TcpTransport:
 
     def _peer(self, node_name: str) -> Optional[_Peer]:
         with self._lock:
+            if self._closed:
+                # close() already swept the peer table: a late send
+                # must not spawn a writer that would park (untimed)
+                # with nobody left to close it
+                return None
             p = self._peers.get(node_name)
             if p is not None:
                 return p
@@ -263,7 +268,11 @@ class TcpTransport:
         while not self._closed and not peer.closed:
             with peer.cv:
                 while not peer.outbox and not peer.closed and not self._closed:
-                    peer.cv.wait(timeout=0.5)
+                    # event-driven idle: every enqueue notifies the
+                    # peer cv and close() marks peer.closed under it —
+                    # an idle sender consumes zero CPU
+                    # (docs/INTERNALS.md §16)
+                    peer.cv.wait()
                 if peer.closed or self._closed:
                     break
                 frames = []

@@ -78,6 +78,7 @@ from ra_tpu.protocol import (
     InstallSnapshotRpc,
     LogEvent,
     NOOP,
+    REJECT_OVERLOADED,
     NodeEvent,
     PreVoteResult,
     PreVoteRpc,
@@ -240,6 +241,14 @@ class Server:
         self.voted_for: Optional[ServerId] = meta.fetch(cfg.uid, "voted_for", None)
         self.commit_index: int = 0
         self.last_applied: int = meta.fetch(cfg.uid, "last_applied", 0)
+        # admission-window release gate (docs/INTERNALS.md §16): a
+        # rejected client parks on a waiter carried in the reject reply
+        # and is woken the moment apply progress frees window room —
+        # the actor-backend mirror of the batch coordinator's _adm_gate
+        # (clients are process-local; the gate never crosses the wire)
+        from ra_tpu.rings import WaitGate
+
+        self._adm_gate = WaitGate()
 
         # machine versioning (reference: src/ra_server.erl:223-233)
         self.machine_version: int = self.machine.version()
@@ -747,7 +756,13 @@ class Server:
             if backlog >= self.cfg.max_command_backlog:
                 if cmd.from_ref is not None:
                     self._c("commands_rejected")
-                    effects.append(Reply(cmd.from_ref, ("reject", "overloaded")))
+                    # the third element is the window-release waiter:
+                    # api.process_command parks on it instead of a
+                    # fixed sleep poll (docs/INTERNALS.md §16)
+                    effects.append(Reply(
+                        cmd.from_ref,
+                        REJECT_OVERLOADED + (self._adm_gate.waiter(),),
+                    ))
                 else:
                     self._c("commands_dropped_overload")
                 self._obs_rec.record(
@@ -1193,6 +1208,9 @@ class Server:
 
         self.log.fold(lo, hi, apply_one, None)
         self.last_applied = hi
+        # apply progress released admission-window room: wake parked
+        # rejected clients (one attribute check when none are parked)
+        self._adm_gate.open()
         self._c("applied", hi - lo + 1)
         if not discard_effects:
             for who, corrs in notify.items():

@@ -214,6 +214,24 @@ def main() -> int:
             r"# TYPE ra_group_commit_delay_us gauge",
             r"# TYPE ra_group_commit_waits counter",
             r"# TYPE ra_native_batches counter",
+            # async command plane (docs/INTERNALS.md §16): the live
+            # STARTED cluster above ran its traffic through the
+            # lock-free ingress rings, the event-driven step wakeups,
+            # and the dedicated egress sender thread — the counters
+            # must prove each path actually carried the burst
+            r"ra_ingress_ring_msgs\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_ingress_ring_drains\{[^}]*obs0[^}]*\} (\d+)",
+            r"# TYPE ra_ingress_ring_full counter",  # 0 = healthy
+            r"# TYPE ra_ingress_ring_lanes gauge",
+            r"ra_step_wakeups\{[^}]*obs0[^}]*\} (\d+)",
+            # 0 is the invariant value while idle; presence is the gate
+            # (the zero assertion lives in tests/test_command_plane.py)
+            r"# TYPE ra_step_spurious_wakeups counter",
+            r"ra_egress_thread_batches\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_egress_thread_msgs\{[^}]*obs0[^}]*\} (\d+)",
+            r"# TYPE ra_egress_thread_ring_full counter",
+            r"# TYPE ra_staging_passes counter",
+            r"# TYPE ra_staging_prezeroed counter",
             # health plane families (docs/INTERNALS.md §14)
             r"ra_health_scans\{[^}]*obs0[^}]*\} (\d+)",
             r"ra_health_fetches\{[^}]*obs0[^}]*\} (\d+)",
