@@ -59,7 +59,10 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
     ("force_elections", "counter", "forced elections"),
     ("applied", "counter", "entries applied to the machine"),
     ("releases", "counter", "release-cursor truncations"),
-    ("reserved_1", "counter", "reserved"),
+    ("check_quorum_stepdowns", "counter",
+     "leader step-downs because a quorum of voters went silent past the "
+     "check-quorum window (one-way partition protection: a leader that "
+     "can send but not hear acks must not reign uselessly)"),
     ("num_segments", "gauge", "number of live segment files"),
     ("compactions", "counter", "compactions run"),
     ("local_queries", "counter", "local queries served"),
@@ -204,6 +207,41 @@ DETECTOR_FIELDS: List[FieldSpec] = [
     ("phi_suspect", "gauge", "1 while the peer is suspected, else 0"),
     ("phi_intervals", "gauge",
      "learned liveness-cadence samples in window"),
+]
+
+# Nemesis-plane vector (name ("nemesis", run_label); written by the
+# nemesis Planner thread only). One inject/heal counter pair per fault
+# dimension so a soak can prove every enabled dimension actually fired
+# (a quiet schedule absorbing a dimension reads as injected == 0).
+NEMESIS_FIELDS: List[FieldSpec] = [
+    ("nemesis_partition_injected", "counter",
+     "symmetric partitions injected"),
+    ("nemesis_partition_healed", "counter", "symmetric partitions healed"),
+    ("nemesis_oneway_injected", "counter",
+     "one-way (asymmetric) partitions injected"),
+    ("nemesis_oneway_healed", "counter", "one-way partitions healed"),
+    ("nemesis_disk_injected", "counter",
+     "disk failpoints armed (faults.py registry)"),
+    ("nemesis_disk_healed", "counter", "disk failpoints disarmed"),
+    ("nemesis_crash_injected", "counter",
+     "node/coordinator crash-restarts injected"),
+    ("nemesis_crash_healed", "counter",
+     "crash-restart recoveries completed"),
+    ("nemesis_membership_injected", "counter",
+     "membership churn steps (remove+add cycles) injected"),
+    ("nemesis_membership_healed", "counter",
+     "membership churn steps completed (member rejoined)"),
+    ("nemesis_overload_injected", "counter",
+     "overload bursts (ack-free floods past the admission window)"),
+    ("nemesis_overload_healed", "counter",
+     "overload bursts drained (flood ended, lane live again)"),
+    ("nemesis_modeflip_injected", "counter",
+     "active-set step-mode flips injected (batch backend)"),
+    ("nemesis_modeflip_healed", "counter",
+     "active-set mode restored to its pre-fault value"),
+    ("nemesis_heals_forced", "counter",
+     "teardown heals forced on exit paths (0 unless a run exited with "
+     "faults still armed — the heal-on-every-exit-path guarantee)"),
 ]
 
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [

@@ -1,4 +1,4 @@
-"""Randomized KV consistency harness.
+"""Randomized KV/FIFO consistency harness over the composable nemesis.
 
 The counterpart of the reference's shipped ``ra_kv_harness``
 (reference: ``src/ra_kv_harness.erl:21-35`` — a long-running loop of
@@ -17,10 +17,28 @@ against either execution backend:
   and the same ``disk_faults`` dimension (a failed WAL on a batch
   node triggers a crash-restart from last-known-durable state).
 
+Fault execution lives in ``ra_tpu.nemesis``: each dimension is a
+``Dimension`` object behind a seeded ``Planner`` whose context manager
+guarantees heal + ``disarm_all`` on EVERY exit path. Flag-gated runs
+fire single dimensions from the legacy workload dice (seed-compatible);
+``combined=True`` lets the planner's own schedule interleave ALL
+dimensions at once — including one-way partitions, overload bursts and
+(batch) live active-set mode flips — which is the soak regime.
+
+Two workloads:
+
+- ``workload="kv"`` (default): random put/delete/get against
+  ``DictKv`` with an uncertainty-tracking reference model;
+- ``workload="fifo"``: the ``FifoMachine`` queue — enqueue/checkout/
+  settle/return/consumer-down with a client-side checker asserting
+  zero lost and zero duplicated settled messages, then a full drain
+  plus a release-cursor reclamation check.
+
 Semantics: commands that time out MAY still have committed — the model
 tracks such keys as "uncertain" and accepts either outcome until the
 next successful write resolves them (the same at-least-once accounting
-the reference harness uses).
+the reference harness uses). Fifo enqueues are sent WITHOUT retry so an
+ack means exactly-one application and the duplicate check is strict.
 
 Usage (tests call ``run`` directly; ops can run it standalone)::
 
@@ -30,13 +48,16 @@ Usage (tests call ``run`` directly; ops can run it standalone)::
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ra_tpu import api, faults, leaderboard
+from ra_tpu import nemesis as nem
 from ra_tpu.machine import Machine
+from ra_tpu.models.fifo import FifoMachine
 from ra_tpu.protocol import Command, ElectionTimeout, ServerId, USR
 from ra_tpu.runtime.transport import registry as node_registry
 from ra_tpu.system import SystemConfig
@@ -86,26 +107,38 @@ def _kv_factory(config):
     return DictKv()
 
 
+def _fifo_factory(config):
+    return FifoMachine()
+
+
 @dataclasses.dataclass
 class HarnessResult:
     consistent: bool
     failures: List[str]
     ops: Dict[str, int]
     final_model: Dict[str, Any]
+    # per-dimension nemesis counter deltas for THIS run (the soak
+    # asserts every enabled dimension actually fired) and the planner's
+    # replayable action schedule (part of the repro bundle)
+    nemesis: Dict[str, int] = dataclasses.field(default_factory=dict)
+    schedule: List[Tuple] = dataclasses.field(default_factory=list)
 
 
-# seeded disk-fault menu: every entry self-heals (one-shots disarm on
-# fire; the node supervision / harness infra check recovers the rest)
-_DISK_FAULT_MENU: List[Tuple[str, Tuple, Tuple]] = [
-    ("wal.fsync", ("raise", "eio"), ("one_shot",)),
-    ("wal.write", ("torn", 0.5), ("one_shot",)),
-    ("wal.write", ("raise", "enospc"), ("one_shot",)),
-    ("wal.thread", ("crash",), ("one_shot",)),
-    ("segment_writer.thread", ("crash",), ("one_shot",)),
-    ("segment_writer.flush", ("raise", "eio"), ("one_shot",)),
-    ("meta.append", ("raise", "eio"), ("one_shot",)),
-    ("wal.fsync", ("latency", 0.02), ("one_shot", 2)),
-]
+# the menu moved to the nemesis plane; kept as an alias for callers
+# that imported it from here
+_DISK_FAULT_MENU = nem.DISK_FAULT_MENU
+
+# key the ack-free combined-mode overload bursts increment: its final
+# value is unknowable a priori (drops are legal), so the model skips it
+# and the harness bounds it by the delivered count instead
+_BURST_KEY = "nb_flood"
+
+
+def _stable(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Project out the burst counter for replica-convergence compares:
+    stragglers from an ack-free burst may commit AFTER the final
+    consistent read, so the key moves under the comparison."""
+    return {k: v for k, v in state.items() if k not in _Model.IGNORED}
 
 
 def run(
@@ -122,6 +155,8 @@ def run(
     disk_faults: bool = False,
     overload: bool = False,
     rings: bool = True,
+    workload: str = "kv",
+    combined: bool = False,
 ) -> HarnessResult:
     """``rescue=True`` lets the harness fire operator election kicks on
     a stuck deployment (useful when hunting consistency bugs past a
@@ -132,34 +167,53 @@ def run(
 
     ``disk_faults=True`` adds a seeded storage-nemesis dimension: ops
     occasionally arm a failpoint (fsync failure, torn write, ENOSPC,
-    infra-thread crash — ``_DISK_FAULT_MENU``) against a random node's
-    storage. On the batch backend, ``restarts=True`` and/or
+    infra-thread crash — ``nemesis.DISK_FAULT_MENU``) against a random
+    node's storage. On the batch backend, ``restarts=True`` and/or
     ``disk_faults=True`` switch the groups onto WAL-backed logs and add
     coordinator crash-restarts recovering from disk.
+
+    ``combined=True`` is the soak regime: EVERY dimension is enabled at
+    once — symmetric AND one-way partitions, disk faults, crash-
+    restarts, membership churn, ack-free overload bursts, (batch) live
+    active-set mode flips — and fault scheduling moves to the planner's
+    own seeded rng, so the nemesis schedule is replayable from the seed
+    alone. ``workload`` picks the machine under test ("kv" | "fifo").
 
     ``rings=False`` runs the batch backend on the lock+deque control
     command plane instead of the lock-free ingress rings (docs/
     INTERNALS.md §16) — the soak's A/B escape hatch; the actor backend
     ignores it."""
+    if combined:
+        partitions = True
+        membership = True
+        disk_faults = True
+        restarts = True
     if restarts is None:
         # backend defaults: member restarts have always been part of the
         # actor mix; batch coordinator crash-restarts (WAL-backed
         # storage) are opt-in — they change the storage substrate
         restarts = backend == "per_group_actor"
+    if workload not in ("kv", "fifo"):
+        raise ValueError(f"unknown workload {workload!r}")
     if backend == "per_group_actor":
         return _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                           membership, op_timeout, rescue, disk_faults,
-                          overload=overload)
+                          overload=overload, workload=workload,
+                          combined=combined)
     if backend == "tpu_batch":
         return _run_batch(seed, n_ops, nodes, partitions, membership,
                           op_timeout, rescue, restarts=restarts,
                           disk_faults=disk_faults, data_dir=data_dir,
-                          overload=overload, rings=rings)
+                          overload=overload, rings=rings, workload=workload,
+                          combined=combined)
     raise ValueError(f"unknown backend {backend!r}")
 
 
 class _Model:
     """Reference map with uncertainty tracking for timed-out writes."""
+
+    # ack-free burst traffic: delivery count is bounded, not exact
+    IGNORED = frozenset({_BURST_KEY})
 
     def __init__(self) -> None:
         self.sure: Dict[str, Any] = {}
@@ -198,6 +252,8 @@ class _Model:
     def check_state(self, state: Dict[str, Any], where: str) -> None:
         keys = set(self.sure) | set(self.maybe) | set(state)
         for k in keys:
+            if k in self.IGNORED:
+                continue
             self.check_read(k, state.get(k), where)
 
 
@@ -349,14 +405,263 @@ def _overload_phase(model, cluster, op_timeout, counts, seed) -> None:
         )
 
 
+# ---------------------------------------------------------------------------
+# fifo workload (ISSUE 13: second harnessed workload over FifoMachine)
+
+
+def _fifo_summary(s):
+    """Deterministic replica fingerprint of a FifoState (used for the
+    converged-replicas check on both backends)."""
+    return (s.next_msg_id, tuple(s.queue),
+            tuple(sorted((c, tuple(sorted(f.items())))
+                         for c, f in s.consumers.items())))
+
+
+def _snapshot_floors(cluster, timeout: float = 2.0) -> List[int]:
+    """Per-member log snapshot floor via state_query (works on both
+    backends: the actor proc hands ``fn`` the Server, the batch
+    coordinator hands it the GroupHost — both expose ``.log``)."""
+    floors: List[int] = []
+    for sid in list(cluster):
+        fut = api.Future()
+        if not api._try_send(
+                sid, ("state_query",
+                      lambda s: s.log.snapshot_index_term(), fut)):
+            continue
+        try:
+            out = fut.result(timeout)
+        except Exception:  # noqa: BLE001 — member busy/partitioned
+            continue
+        if out and out[0] == "ok":
+            it = out[1]
+            floors.append(it[0] if it else 0)
+    return floors
+
+
+class _FifoWorkload:
+    """Client pool + invariant checker for the fifo machine.
+
+    Accounting rules:
+
+    - enqueues go through ``send_once`` (NO retry): an ack means the
+      command applied exactly once, so a payload ever delivered under
+      two distinct msg_ids is a DUPLICATED application — hard failure;
+    - settle/checkout/return/down are idempotent under at-least-once,
+      so they use the retrying sender;
+    - an acked enqueue whose payload is never delivered by the end of
+      the final drain is a LOST message — hard failure;
+    - redeliveries (same msg_id seen again after a ``down`` requeue or
+      ``return``) are the EXPECTED at-least-once behavior and are
+      counted, not failed.
+    """
+
+    N_CONSUMERS = 4
+
+    def __init__(self, seed, failures, send, send_once, cquery) -> None:
+        import threading
+
+        self.seed = seed
+        self.failures = failures
+        self.send = send            # retrying send: idempotent ops only
+        self.send_once = send_once  # single attempt: enqueue
+        self.cquery = cquery
+        self.lock = threading.Lock()
+        self.inbox: collections.deque = collections.deque()
+        self.cids = [f"c{j}" for j in range(self.N_CONSUMERS)]
+        self.drain_cid = "drain"
+        self.active: set = set()
+        self.pending: Dict[str, Dict[int, Any]] = {}
+        self.payload_ids: Dict[str, set] = {}
+        self.delivered: Dict[int, int] = {}
+        self.acked_enq: set = set()
+        self.uncertain_enq: set = set()
+        self.settled: set = set()
+        self.redeliveries = 0
+
+    # -- delivery sink (called from node/coordinator threads) ----------
+
+    def on_delivery(self, cid, msgs) -> None:
+        with self.lock:
+            for m in msgs:
+                self.inbox.append((cid, m))
+
+    def pump(self) -> None:
+        """Fold received deliveries into client state (harness thread)."""
+        with self.lock:
+            items = list(self.inbox)
+            self.inbox.clear()
+        for cid, m in items:
+            if not (isinstance(m, tuple) and len(m) == 3
+                    and m[0] == "delivery"):
+                continue
+            _, msg_id, payload = m
+            ids = self.payload_ids.setdefault(payload, set())
+            ids.add(msg_id)
+            if len(ids) > 1:
+                self.failures.append(
+                    f"fifo: payload {payload!r} delivered under msg_ids "
+                    f"{sorted(ids)} — an enqueue applied more than once")
+            n = self.delivered.get(msg_id, 0)
+            self.delivered[msg_id] = n + 1
+            if n:
+                self.redeliveries += 1
+            if cid in self.active:
+                self.pending.setdefault(cid, {})[msg_id] = payload
+
+    # -- one workload op ----------------------------------------------
+
+    def op(self, rng, op_i, r: float) -> None:
+        """``r`` is the workload roll normalized to [0, 1)."""
+        self.pump()
+        if r < 0.50:
+            payload = f"p{self.seed}_{op_i}"
+            try:
+                self.send_once(("enqueue", payload))
+                self.acked_enq.add(payload)
+            except Exception:  # noqa: BLE001 — may or may not commit
+                self.uncertain_enq.add(payload)
+        elif r < 0.62:
+            cid = rng.choice(self.cids)
+            credit = rng.choice((1, 2, 3, 5))
+            try:
+                self.send(("checkout", cid, credit))
+                self.active.add(cid)
+                self.pending.setdefault(cid, {})
+            except Exception:  # noqa: BLE001 — uncertain: the consumer
+                pass           # may exist; final_check downs every cid
+        elif r < 0.84:
+            cands = [(c, m) for c, mm in self.pending.items() for m in mm]
+            if cands:
+                cid, mid = cands[rng.randrange(len(cands))]
+                try:
+                    self.send(("settle", cid, mid))
+                    self.pending[cid].pop(mid, None)
+                    self.settled.add(mid)
+                except Exception:  # noqa: BLE001 — stays pending;
+                    pass           # settle is idempotent, retried later
+        elif r < 0.89:
+            cands = [(c, m) for c, mm in self.pending.items() for m in mm]
+            if cands:
+                cid, mid = cands[rng.randrange(len(cands))]
+                try:
+                    self.send(("return", cid, mid))
+                    self.pending[cid].pop(mid, None)  # redelivery re-adds
+                except Exception:  # noqa: BLE001
+                    pass
+        elif r < 0.93:
+            if self.active:
+                cid = rng.choice(sorted(self.active))
+                try:
+                    self.send(("down", cid, "nemesis"))
+                except Exception:  # noqa: BLE001 — final_check re-downs
+                    pass
+                self.active.discard(cid)
+                self.pending.pop(cid, None)
+        else:
+            # spot invariant: every acked enqueue must already be applied
+            try:
+                applied = self.cquery(lambda s: s.next_msg_id) - 1
+                if applied < len(self.acked_enq):
+                    self.failures.append(
+                        f"fifo op{op_i}: {len(self.acked_enq)} acked "
+                        f"enqueues but only {applied} applied — lost acks")
+            except Exception:  # noqa: BLE001 — no leader right now
+                pass
+
+    # -- final conservation check -------------------------------------
+
+    def final_check(self, cluster, tick=None) -> None:
+        """On the healed cluster: tear down every consumer ever touched
+        (``down`` is idempotent, so uncertain checkouts are covered),
+        drain the queue through a fresh wide-credit consumer, then
+        assert conservation — every acked payload delivered, none
+        duplicated — and that the final release cursor actually
+        reclaimed the log (snapshot floor advanced)."""
+        failures = self.failures
+        self.pump()
+        for cid in self.cids:
+            try:
+                self.send(("down", cid, "teardown"))
+            except Exception:  # noqa: BLE001
+                failures.append(
+                    f"fifo: teardown down({cid!r}) never committed")
+        self.active.clear()
+        self.pending = {}
+        try:
+            self.send(("checkout", self.drain_cid, 4096))
+        except Exception:  # noqa: BLE001
+            failures.append("fifo: drain consumer checkout never committed")
+            return
+        self.active.add(self.drain_cid)
+        self.pending.setdefault(self.drain_cid, {})
+        emptied = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if tick is not None:
+                tick()
+            self.pump()
+            mm = self.pending.get(self.drain_cid, {})
+            for mid in list(mm):
+                try:
+                    self.send(("settle", self.drain_cid, mid))
+                    mm.pop(mid, None)
+                    self.settled.add(mid)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                ready, inflight = self.cquery(
+                    lambda s: (len(s.queue),
+                               sum(len(f) for f in s.consumers.values())))
+                if ready == 0 and inflight == 0:
+                    emptied = True
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.05)
+        if not emptied:
+            failures.append(
+                "fifo: drain never emptied the queue — messages stuck "
+                "in ready/in-flight after heal")
+        lost = self.acked_enq - set(self.payload_ids)
+        if lost:
+            failures.append(
+                f"fifo: {len(lost)} acked enqueues never delivered "
+                f"(lost): {sorted(lost)[:5]}")
+        if emptied and self.settled:
+            # the settle that emptied the queue emitted ReleaseCursor on
+            # every replica: some member's log snapshot floor must
+            # advance past 0 (snapshot install may lag the apply)
+            floor = 0
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                floor = max(_snapshot_floors(cluster) or [0])
+                if floor > 0:
+                    break
+                time.sleep(0.2)
+            if floor <= 0:
+                failures.append(
+                    "fifo: drained + settled but no replica's snapshot "
+                    "floor advanced — release-cursor truncation never "
+                    "reclaimed the log")
+
+
+# ---------------------------------------------------------------------------
+# backends
+
+
 def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                membership, op_timeout, rescue=False,
-               disk_faults=False, overload=False) -> HarnessResult:
+               disk_faults=False, overload=False, workload="kv",
+               combined=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.machine import register_machine_factory
 
     register_machine_factory("ra_tpu_kv_harness", _kv_factory)
+    register_machine_factory("ra_tpu_fifo_harness", _fifo_factory)
+    mach_cls = FifoMachine if workload == "fifo" else DictKv
+    factory_name = ("ra_tpu_fifo_harness" if workload == "fifo"
+                    else "ra_tpu_kv_harness")
     rng = random.Random(seed)
     base = data_dir or tempfile.mkdtemp(prefix="ra_kv_harness_")
     names = [f"kvh{seed}_{i}" for i in range(nodes + 1)]  # +1 spare for joins
@@ -365,35 +670,102 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             n, SystemConfig(
                 name=f"kvh{seed}", data_dir=f"{base}/{n}",
                 default_max_command_backlog=(
-                    _OVERLOAD_BACKLOG if overload else 4096
+                    _OVERLOAD_BACKLOG if (overload or combined) else 4096
                 ),
+                # production logs batch release cursors into 4096-entry
+                # snapshots; at harness scale that hides reclamation —
+                # snapshot on every cursor so the fifo checker can see it
+                min_snapshot_interval=1,
             ),
             election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
         )
     ids = [(f"kv{i}", names[i]) for i in range(nodes)]
     spare = (f"kv{nodes}", names[nodes])
     cluster = list(ids)
-    api.start_cluster(f"kvhc{seed}", DictKv, ids, timeout=20)
+    api.start_cluster(f"kvhc{seed}", mach_cls, ids, timeout=20)
     model = _Model()
     counts: Dict[str, int] = {}
-    partitioned: Optional[str] = None
     # rescue randomness separate from the workload stream (seed
     # determinism of the op sequence survives wall-clock rescues)
     rescue_rng = random.Random(seed ^ 0x5EED)
+    consecutive_failures = [0]
 
-    def heal():
-        nonlocal partitioned
+    # -- nemesis context: how each dimension executes on this backend --
+
+    def _block(a, b):
+        na = node_registry().get(a)
+        if na is not None:
+            na.transport.block(a, b)
+
+    def _unblock_all():
         for n in names:
             node = node_registry().get(n)
             if node is not None:
                 node.transport.unblock_all()
-        partitioned = None
-        if disk_faults:
-            # bound the unavailability window: armed-but-unfired
-            # failpoints disarm along with partitions
-            faults.disarm_all()
 
-    consecutive_failures = [0]
+    def _restart(victim):
+        counts["restart_fired"] = counts.get("restart_fired", 0) + 1
+        sid = next(s for s in cluster if s[1] == victim)
+        try:
+            api.restart_server(sid)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _membership_step():
+        try:
+            if spare in cluster and len(cluster) > 3:
+                out = api.remove_member(cluster[0], spare,
+                                        timeout=op_timeout)
+                if out[0] == "ok":
+                    node = node_registry().get(spare[1])
+                    if node is not None and spare[0] in node.procs:
+                        node.stop_server(spare[0])
+                    cluster.remove(spare)
+                    return "remove"
+            elif spare not in cluster:
+                api.start_server(
+                    spare, f"kvhc{seed}", None, cluster + [spare],
+                    machine_factory=factory_name,
+                )
+                out = api.add_member(cluster[0], spare, timeout=op_timeout)
+                if out[0] == "ok":
+                    cluster.append(spare)
+                    return "add"
+        except Exception:  # noqa: BLE001 — change may be rejected
+            pass
+        return None
+
+    burst_sent = [0]
+    burst_data = (("settle", "__burst__", 0) if workload == "fifo"
+                  else ("incr", _BURST_KEY, 1))
+
+    def _overload_burst():
+        cmd = Command(kind=USR, data=burst_data, reply_mode="noreply")
+        chunk = [cmd] * _OVERLOAD_BACKLOG
+        targets = set(cluster)
+        cl_name = api._cluster_of(cluster[0])
+        lead = leaderboard.lookup_leader(cl_name) if cl_name else None
+        if lead is not None:
+            targets.add(lead)
+        sent = 0
+        for sid in targets:
+            sent += api._try_send_many(sid, chunk)
+        burst_sent[0] += sent
+        return sent
+
+    dims = nem.standard_dimensions(
+        partitions=partitions, oneway=combined, disk_faults=disk_faults,
+        restarts=restarts, membership=membership, overload=combined,
+        mode_flips=False)
+    ctx = nem.NemesisContext(
+        peers=lambda: list(names),
+        members=lambda: [n for _, n in cluster],
+        block=_block, unblock_all=_unblock_all,
+        restart=_restart, membership_step=_membership_step,
+        fault_scopes=lambda: names[:nodes],
+        overload_burst=_overload_burst)
+    planner = nem.Planner(ctx, seed, f"kvh{seed}", dims)
+    ctr0 = planner.counters()
 
     def write(cmd):
         try:
@@ -407,130 +779,179 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             model.uncertain(cmd)
             consecutive_failures[0] += 1
 
-    try:
-        for op_i in range(n_ops):
-            if partitioned is not None and op_i % 20 == 19:
-                heal()  # bound leaderless stretches
-            if consecutive_failures[0] >= 4:
-                # nemesis bounds unavailability by healing; electing a
-                # new leader is the CLUSTER's job (rescue mode may kick
-                # one when hunting past a known liveness bug)
-                heal()
-                if rescue:
-                    try:
-                        api.trigger_election(rescue_rng.choice(cluster))
-                    except Exception:  # noqa: BLE001
-                        pass
-                consecutive_failures[0] = 0
-            roll = rng.random()
-            key = f"k{rng.randrange(12)}"
-            if roll < 0.45:
-                counts["put"] = counts.get("put", 0) + 1
-                write(("put", key, rng.randrange(1000)))
-            elif roll < 0.6:
-                counts["delete"] = counts.get("delete", 0) + 1
-                write(("delete", key))
-            elif roll < 0.8:
-                counts["get"] = counts.get("get", 0) + 1
-                try:
-                    out = api.consistent_query(
-                        rng.choice(cluster), lambda s: dict(s),
-                        timeout=op_timeout,
-                    )
-                    model.check_state(out[1], f"op{op_i} consistent_query")
-                except Exception:  # noqa: BLE001 — no leader right now
-                    pass
-            elif roll < 0.87 and partitions:
-                counts["partition"] = counts.get("partition", 0) + 1
-                if partitioned is None and rng.random() < 0.7:
-                    victim = rng.choice(cluster)[1]
-                    for n in names:
-                        if n != victim:
-                            a = node_registry().get(victim)
-                            b = node_registry().get(n)
-                            if a is not None:
-                                a.transport.block(victim, n)
-                            if b is not None:
-                                b.transport.block(n, victim)
-                    partitioned = victim
-                else:
-                    heal()
-            elif roll < 0.94 and restarts:
-                counts["restart"] = counts.get("restart", 0) + 1
-                sid = rng.choice(cluster)
-                if sid[1] != partitioned:
-                    try:
-                        api.restart_server(sid)
-                    except Exception:  # noqa: BLE001
-                        pass
-            elif roll < 0.97 and disk_faults:
-                # seeded storage nemesis: arm one failpoint against a
-                # random node's storage; node supervision must heal it
-                counts["disk_fault"] = counts.get("disk_fault", 0) + 1
-                site, action, trigger = rng.choice(_DISK_FAULT_MENU)
-                faults.arm(site, action, trigger,
-                           seed=rng.randrange(1 << 30),
-                           scope=rng.choice(names[:nodes]))
-            elif membership and partitioned is None:
-                # membership changes only on a healed cluster: removing
-                # an alive member while another is partitioned away can
-                # drop below quorum and wedge until the next heal roll
-                counts["membership"] = counts.get("membership", 0) + 1
-                try:
-                    if spare in cluster and len(cluster) > 3:
-                        out = api.remove_member(cluster[0], spare,
-                                                timeout=op_timeout)
-                        if out[0] == "ok":
-                            node = node_registry().get(spare[1])
-                            if node is not None and spare[0] in node.procs:
-                                node.stop_server(spare[0])
-                            cluster.remove(spare)
-                    elif spare not in cluster:
-                        api.start_server(
-                            spare, f"kvhc{seed}", None, cluster + [spare],
-                            machine_factory="ra_tpu_kv_harness",
-                        )
-                        out = api.add_member(cluster[0], spare,
-                                             timeout=op_timeout)
-                        if out[0] == "ok":
-                            cluster.append(spare)
-                except Exception:  # noqa: BLE001 — change may be rejected
-                    pass
-
-        heal()
-        # quiesce, then every replica must converge to the model
-        final = None
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
+    if workload == "fifo":
+        def _send(cmd):
             try:
-                out = api.consistent_query(cluster[0], lambda s: dict(s),
-                                           timeout=op_timeout)
-                final = out[1]
-                break
-            except Exception:  # noqa: BLE001
-                time.sleep(0.2)
-        if final is None:
-            model.failures.append("no leader after heal: cluster wedged")
-        else:
-            model.check_state(final, "final consistent read")
-            deadline = time.monotonic() + 30
-            laggards = list(cluster)
-            while time.monotonic() < deadline and laggards:
-                still = []
-                for sid in laggards:
+                api.process_command(rng.choice(cluster), cmd,
+                                    timeout=op_timeout, retry_on_timeout=True)
+                consecutive_failures[0] = 0
+            except Exception:
+                consecutive_failures[0] += 1
+                raise
+
+        def _send_once(cmd):
+            try:
+                api.process_command(rng.choice(cluster), cmd,
+                                    timeout=op_timeout)
+                consecutive_failures[0] = 0
+            except Exception:
+                consecutive_failures[0] += 1
+                raise
+
+        fifo = _FifoWorkload(
+            seed, model.failures, _send, _send_once,
+            lambda fn: api.consistent_query(cluster[0], fn,
+                                            timeout=op_timeout)[1])
+        # node-level sinks survive server restarts AND membership churn:
+        # register every consumer on every node (incl. the spare) so the
+        # delivery effect finds its client wherever the leader sits
+        for n in names:
+            for cid in fifo.cids + [fifo.drain_cid]:
+                api.register_client(
+                    n, cid,
+                    (lambda c: lambda _sid, msgs:
+                        fifo.on_delivery(c, msgs))(cid))
+    else:
+        fifo = None
+
+    anomalies = None
+    try:
+        with planner:
+            for op_i in range(n_ops):
+                if planner.net_active and op_i % 20 == 19:
+                    planner.heal_transient(op_i)  # bound leaderless stretches
+                if consecutive_failures[0] >= 4:
+                    # nemesis bounds unavailability by healing; electing a
+                    # new leader is the CLUSTER's job (rescue mode may kick
+                    # one when hunting past a known liveness bug)
+                    planner.heal_transient(op_i)
+                    if rescue:
+                        try:
+                            api.trigger_election(rescue_rng.choice(cluster))
+                        except Exception:  # noqa: BLE001
+                            pass
+                    consecutive_failures[0] = 0
+                if combined:
+                    planner.step(op_i)
+                roll = rng.random()
+                key = f"k{rng.randrange(12)}"
+                if combined:
+                    # fault scheduling belongs to planner.step above: map
+                    # the whole roll onto the workload region so the
+                    # legacy thresholds keep their relative weights
+                    roll *= 0.8
+                if roll < 0.8 and workload == "fifo":
+                    fifo.op(rng, op_i, roll / 0.8)
+                elif roll < 0.45:
+                    counts["put"] = counts.get("put", 0) + 1
+                    write(("put", key, rng.randrange(1000)))
+                elif roll < 0.6:
+                    counts["delete"] = counts.get("delete", 0) + 1
+                    write(("delete", key))
+                elif roll < 0.8:
+                    counts["get"] = counts.get("get", 0) + 1
                     try:
-                        v = api.local_query(sid, lambda s: dict(s))[1]
-                        if v != final:
-                            still.append(sid)
+                        out = api.consistent_query(
+                            rng.choice(cluster), lambda s: dict(s),
+                            timeout=op_timeout,
+                        )
+                        model.check_state(out[1],
+                                          f"op{op_i} consistent_query")
+                    except Exception:  # noqa: BLE001 — no leader right now
+                        pass
+                elif roll < 0.87 and partitions:
+                    counts["partition"] = counts.get("partition", 0) + 1
+                    planner.fire("partition", rng, op_i)
+                elif roll < 0.94 and restarts:
+                    counts["restart"] = counts.get("restart", 0) + 1
+                    planner.fire("crash", rng, op_i)
+                elif roll < 0.97 and disk_faults:
+                    # seeded storage nemesis: arm one failpoint against a
+                    # random node's storage; node supervision must heal it
+                    counts["disk_fault"] = counts.get("disk_fault", 0) + 1
+                    planner.fire("disk", rng, op_i)
+                elif membership and planner.sym_victim is None:
+                    # membership changes only on a healed cluster: removing
+                    # an alive member while another is partitioned away can
+                    # drop below quorum and wedge until the next heal roll
+                    counts["membership"] = counts.get("membership", 0) + 1
+                    planner.fire("membership", rng, op_i)
+
+            planner.heal_all(n_ops)
+            if workload == "fifo":
+                fifo.final_check(cluster)
+                try:
+                    final_sum = api.consistent_query(
+                        cluster[0], _fifo_summary, timeout=op_timeout)[1]
+                except Exception:  # noqa: BLE001
+                    final_sum = None
+                    model.failures.append(
+                        "no leader after heal: cluster wedged")
+                if final_sum is not None:
+                    deadline = time.monotonic() + 30
+                    laggards = list(cluster)
+                    while time.monotonic() < deadline and laggards:
+                        still = []
+                        for sid in laggards:
+                            try:
+                                v = api.local_query(sid, _fifo_summary)[1]
+                                if v != final_sum:
+                                    still.append(sid)
+                            except Exception:  # noqa: BLE001
+                                still.append(sid)
+                        laggards = still
+                        if laggards:
+                            time.sleep(0.2)
+                    for sid in laggards:
+                        model.failures.append(
+                            f"replica {sid} never converged")
+                counts["fifo_redeliveries"] = fifo.redeliveries
+                counts["fifo_settled"] = len(fifo.settled)
+            else:
+                # quiesce, then every replica must converge to the model
+                final = None
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        out = api.consistent_query(
+                            cluster[0], lambda s: dict(s),
+                            timeout=op_timeout)
+                        final = out[1]
+                        break
                     except Exception:  # noqa: BLE001
-                        still.append(sid)
-                laggards = still
-                if laggards:
-                    time.sleep(0.2)
-            for sid in laggards:
-                model.failures.append(f"replica {sid} never converged")
-        if overload and not model.failures:
-            _overload_phase(model, cluster, op_timeout, counts, seed)
+                        time.sleep(0.2)
+                if final is None:
+                    model.failures.append(
+                        "no leader after heal: cluster wedged")
+                else:
+                    model.check_state(final, "final consistent read")
+                    deadline = time.monotonic() + 30
+                    laggards = list(cluster)
+                    want = _stable(final)
+                    while time.monotonic() < deadline and laggards:
+                        still = []
+                        for sid in laggards:
+                            try:
+                                v = api.local_query(sid,
+                                                    lambda s: dict(s))[1]
+                                if _stable(v) != want:
+                                    still.append(sid)
+                            except Exception:  # noqa: BLE001
+                                still.append(sid)
+                        laggards = still
+                        if laggards:
+                            time.sleep(0.2)
+                    for sid in laggards:
+                        model.failures.append(
+                            f"replica {sid} never converged")
+                    flood = final.get(_BURST_KEY, 0)
+                    if flood > burst_sent[0]:
+                        model.failures.append(
+                            f"overload bursts: {_BURST_KEY}={flood} > "
+                            f"{burst_sent[0]} delivered — duplicated "
+                            f"ack-free commands")
+            if overload and workload == "kv" and not model.failures:
+                _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         anomalies = _capture_health(model.failures)
         if disk_faults:
@@ -541,11 +962,14 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             except Exception:  # noqa: BLE001
                 pass
         leaderboard.clear()
+    nem_counts = {k: v - ctr0.get(k, 0)
+                  for k, v in planner.counters().items()}
     _dump_on_failure(model.failures, f"actor seed={seed}",
-                     anomalies=anomalies)
+                     anomalies=anomalies, planner=planner)
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
-        ops=counts, final_model=dict(model.sure),
+        ops=counts, final_model=dict(model.sure), nemesis=nem_counts,
+        schedule=list(planner.schedule),
     )
 
 
@@ -562,18 +986,22 @@ def _capture_health(failures):
         return None
 
 
-def _dump_on_failure(failures, label: str, anomalies=None) -> None:
-    """Consistency/liveness failure -> dump the flight recorder plus
-    the health plane's anomaly view: the post-mortem event trace
-    (elections, depositions, failpoint fires, watchdog strikes, health
-    transitions) and "which groups were stuck/lagging/flapping at
-    death" are what make a nemesis flake debuggable."""
+def _dump_on_failure(failures, label: str, anomalies=None,
+                     planner=None) -> None:
+    """Consistency/liveness failure -> dump the repro bundle: the
+    flight recorder (elections, depositions, failpoint fires, watchdog
+    strikes, nemesis events interleaved), the planner's replayable
+    nemesis schedule (pure function of the seed), and the health
+    plane's anomaly view ("which groups were stuck/lagging/flapping at
+    death")."""
     if failures:
         import sys
 
         from ra_tpu import obs
 
         obs.flight_recorder().dump(header=f" [kv_harness {label}]")
+        if planner is not None:
+            planner.dump_schedule(header=f" [kv_harness {label}]")
         if anomalies is not None:
             print(f"-- cluster health at failure ({label}): "
                   f"{len(anomalies)} anomalous groups --", file=sys.stderr)
@@ -586,7 +1014,8 @@ def _dump_on_failure(failures, label: str, anomalies=None) -> None:
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
-               data_dir=None, overload=False, rings=True) -> HarnessResult:
+               data_dir=None, overload=False, rings=True, workload="kv",
+               combined=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.log.log import Log
@@ -600,12 +1029,50 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
     rng = random.Random(seed)
     names = [f"kvb{seed}_{i}" for i in range(nodes + 1)]  # +1 spare for joins
     gname = "kvbg0"
+    mach_cls = FifoMachine if workload == "fifo" else DictKv
     # restarts/disk_faults need real durability: WAL-backed logs, a
     # file meta store, and per-node storage that a crash-restart can
     # rebuild from (VERDICT item 7's crash-restart nemesis shape)
     use_disk = restarts or disk_faults
     base = (data_dir or tempfile.mkdtemp(prefix="ra_kv_batch_")) if use_disk else None
     storage: Dict[str, dict] = {}
+    model = _Model()
+    counts: Dict[str, int] = {}
+    consecutive_failures = [0]
+    # rescue randomness is separate from the workload stream: the op
+    # sequence must stay seed-deterministic even though rescues fire on
+    # wall-clock conditions
+    rescue_rng = random.Random(seed ^ 0x5EED)
+
+    if workload == "fifo":
+        def _send(cmd):
+            try:
+                api.process_command(rng.choice(cluster), cmd,
+                                    timeout=op_timeout, retry_on_timeout=True)
+                consecutive_failures[0] = 0
+            except Exception:
+                consecutive_failures[0] += 1
+                raise
+
+        def _send_once(cmd):
+            try:
+                api.process_command(rng.choice(cluster), cmd,
+                                    timeout=op_timeout)
+                consecutive_failures[0] = 0
+            except Exception:
+                consecutive_failures[0] += 1
+                raise
+
+        fifo = _FifoWorkload(
+            seed, model.failures, _send, _send_once,
+            lambda fn: api.consistent_query(cluster[0], fn,
+                                            timeout=op_timeout)[1])
+
+        def fifo_sink(to, msg, options=None):
+            fifo.on_delivery(to, [msg])
+    else:
+        fifo = None
+        fifo_sink = None
 
     def mk_storage(n):
         d = f"{base}/{n}"
@@ -637,14 +1104,19 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
     def mk_log(n):
         st = storage[n]
-        return Log(gname, f"{st['dir']}/data/{gname}", st["tables"], st["wal"])
+        # min_snapshot_interval=1: see _run_actor — release-cursor
+        # reclamation must be observable at harness op counts
+        return Log(gname, f"{st['dir']}/data/{gname}", st["tables"],
+                   st["wal"], min_snapshot_interval=1)
 
     def mk_coord(n):
         c = BatchCoordinator(
             n, capacity=8, num_peers=nodes + 1, tick_interval_s=0.3,
             meta=storage[n]["meta"] if use_disk else None,
-            max_command_backlog=_OVERLOAD_BACKLOG if overload else 4096,
+            max_command_backlog=(
+                _OVERLOAD_BACKLOG if (overload or combined) else 4096),
             rings=rings,
+            send_msg_cb=fifo_sink,
         )
         if use_disk:
             storage[n]["ref"]["c"] = c
@@ -660,7 +1132,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
     cluster = [(gname, n) for n in names[:nodes]]
     spare = (gname, names[nodes])
     for _, n in cluster:
-        coords[n].add_group(gname, f"kvbc{seed}", cluster, DictKv(),
+        coords[n].add_group(gname, f"kvbc{seed}", cluster, mach_cls(),
                             log=mk_log(n) if use_disk else None)
     coords[names[0]].deliver((gname, names[0]), ElectionTimeout(), None)
     deadline = time.monotonic() + 30
@@ -668,22 +1140,17 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         coords[n].by_name[gname].role == C.R_LEADER for _, n in cluster
     ):
         time.sleep(0.05)
-    model = _Model()
-    counts: Dict[str, int] = {}
-    partitioned: Optional[str] = None
-    consecutive_failures = [0]
-    # rescue randomness is separate from the workload stream: the op
-    # sequence must stay seed-deterministic even though rescues fire on
-    # wall-clock conditions
-    rescue_rng = random.Random(seed ^ 0x5EED)
 
-    def heal():
-        nonlocal partitioned
+    # -- nemesis context ----------------------------------------------
+
+    def _block(a, b):
+        c = coords.get(a)
+        if c is not None:
+            c.transport.block(a, b)
+
+    def _unblock_all():
         for c in coords.values():
             c.transport.unblock_all()
-        partitioned = None
-        if disk_faults:
-            faults.disarm_all()
 
     def restart_coord(n):
         """Crash-restart one coordinator: tear it down (RAM state gone)
@@ -701,16 +1168,79 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         c2 = mk_coord(n)
         coords[n] = c2
         c2.start()
-        if partitioned == n:
+        if planner.sym_victim == n:
             # the fresh transport lost the victim-side blocks: re-arm
             # them so a crash-restart never half-dissolves an active
             # partition (the other sides' blocks are still in place)
             for m in names:
                 if m != n:
                     c2.transport.block(n, m)
+        if planner.oneway_pair is not None and planner.oneway_pair[0] == n:
+            c2.transport.block(*planner.oneway_pair)
         if (gname, n) in cluster:
-            c2.add_group(gname, f"kvbc{seed}", list(cluster), DictKv(),
+            c2.add_group(gname, f"kvbc{seed}", list(cluster), mach_cls(),
                          log=mk_log(n))
+
+    def _membership_step():
+        try:
+            if spare in cluster:
+                out = api.remove_member(cluster[0], spare,
+                                        timeout=op_timeout)
+                if out[0] == "ok":
+                    cluster.remove(spare)
+                    return "remove"
+            else:
+                coords[spare[1]].add_group(
+                    gname, f"kvbc{seed}", cluster + [spare], mach_cls(),
+                    log=mk_log(spare[1]) if use_disk else None,
+                )
+                out = api.add_member(cluster[0], spare, timeout=op_timeout)
+                if out[0] == "ok":
+                    cluster.append(spare)
+                    return "add"
+        except Exception:  # noqa: BLE001 — change may be rejected
+            pass
+        return None
+
+    burst_sent = [0]
+    burst_data = (("settle", "__burst__", 0) if workload == "fifo"
+                  else ("incr", _BURST_KEY, 1))
+
+    def _overload_burst():
+        cmd = Command(kind=USR, data=burst_data, reply_mode="noreply")
+        chunk = [cmd] * _OVERLOAD_BACKLOG
+        targets = set(cluster)
+        cl_name = api._cluster_of(cluster[0])
+        lead = leaderboard.lookup_leader(cl_name) if cl_name else None
+        if lead is not None:
+            targets.add(lead)
+        sent = 0
+        for sid in targets:
+            sent += api._try_send_many(sid, chunk)
+        burst_sent[0] += sent
+        return sent
+
+    def _set_mode(m):
+        for c in coords.values():
+            c.active_set = m
+
+    def _get_mode():
+        return coords[names[0]].active_set
+
+    dims = nem.standard_dimensions(
+        partitions=partitions, oneway=combined, disk_faults=disk_faults,
+        restarts=use_disk and restarts, membership=membership,
+        overload=combined, mode_flips=combined)
+    ctx = nem.NemesisContext(
+        peers=lambda: list(names),
+        members=lambda: [n for _, n in cluster],
+        block=_block, unblock_all=_unblock_all,
+        restart=restart_coord, membership_step=_membership_step,
+        fault_scopes=lambda: names[:nodes],
+        overload_burst=_overload_burst,
+        set_mode=_set_mode, get_mode=_get_mode)
+    planner = nem.Planner(ctx, seed, f"kvb{seed}", dims)
+    ctr0 = planner.counters()
 
     def check_infra():
         """Per-op storage health sweep (the batch backend has no RaNode
@@ -749,119 +1279,137 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
             model.uncertain(cmd)
             consecutive_failures[0] += 1
 
+    anomalies = None
     try:
-        for op_i in range(n_ops):
+        with planner:
+            for op_i in range(n_ops):
+                if use_disk:
+                    check_infra()
+                if consecutive_failures[0] >= 4:
+                    # nemesis heal only; recovery is the cluster's job
+                    # (see _run_actor)
+                    planner.heal_transient(op_i)
+                    if rescue:
+                        kick()
+                    consecutive_failures[0] = 0
+                if combined:
+                    planner.step(op_i)
+                roll = rng.random()
+                key = f"k{rng.randrange(12)}"
+                if combined:
+                    roll *= 0.85  # see _run_actor: workload region only
+                if roll < 0.85 and workload == "fifo":
+                    fifo.op(rng, op_i, roll / 0.85)
+                elif roll < 0.5:
+                    counts["put"] = counts.get("put", 0) + 1
+                    write(("put", key, rng.randrange(1000)))
+                elif roll < 0.65:
+                    counts["delete"] = counts.get("delete", 0) + 1
+                    write(("delete", key))
+                elif roll < 0.85:
+                    counts["get"] = counts.get("get", 0) + 1
+                    try:
+                        out = api.consistent_query(
+                            rng.choice(cluster), lambda s: dict(s),
+                            timeout=op_timeout,
+                        )
+                        model.check_state(out[1],
+                                          f"op{op_i} consistent_query")
+                    except Exception:  # noqa: BLE001
+                        pass
+                elif roll < 0.90 and use_disk and restarts:
+                    # coordinator crash-restart: all RAM state dropped,
+                    # rebuilt from WAL/meta/segments mid-workload
+                    planner.fire("crash", rng, op_i)
+                elif roll < 0.93 and partitions:
+                    counts["partition"] = counts.get("partition", 0) + 1
+                    planner.fire("partition", rng, op_i)
+                elif roll < 0.96 and disk_faults:
+                    counts["disk_fault"] = counts.get("disk_fault", 0) + 1
+                    planner.fire("disk", rng, op_i)
+                elif membership and planner.sym_victim is None:
+                    counts["membership"] = counts.get("membership", 0) + 1
+                    planner.fire("membership", rng, op_i)
+
+            planner.heal_all(n_ops)
             if use_disk:
                 check_infra()
-            if consecutive_failures[0] >= 4:
-                # nemesis heal only; recovery is the cluster's job
-                # (see _run_actor)
-                heal()
-                if rescue:
-                    kick()
-                consecutive_failures[0] = 0
-            roll = rng.random()
-            key = f"k{rng.randrange(12)}"
-            if roll < 0.5:
-                counts["put"] = counts.get("put", 0) + 1
-                write(("put", key, rng.randrange(1000)))
-            elif roll < 0.65:
-                counts["delete"] = counts.get("delete", 0) + 1
-                write(("delete", key))
-            elif roll < 0.85:
-                counts["get"] = counts.get("get", 0) + 1
+            if workload == "fifo":
+                fifo.final_check(cluster,
+                                 tick=check_infra if use_disk else None)
                 try:
-                    out = api.consistent_query(
-                        rng.choice(cluster), lambda s: dict(s),
-                        timeout=op_timeout,
-                    )
-                    model.check_state(out[1], f"op{op_i} consistent_query")
+                    final_sum = api.consistent_query(
+                        cluster[0], _fifo_summary, timeout=op_timeout)[1]
                 except Exception:  # noqa: BLE001
-                    pass
-            elif roll < 0.90 and use_disk and restarts:
-                # coordinator crash-restart: all RAM state dropped,
-                # rebuilt from WAL/meta/segments mid-workload
-                victim = rng.choice([n for _, n in cluster])
-                if victim != partitioned:
-                    restart_coord(victim)
-            elif roll < 0.93 and partitions:
-                counts["partition"] = counts.get("partition", 0) + 1
-                if partitioned is None and rng.random() < 0.7:
-                    victim = rng.choice([n for _, n in cluster])
-                    for n in names:
-                        if n != victim:
-                            coords[victim].transport.block(victim, n)
-                            coords[n].transport.block(n, victim)
-                    partitioned = victim
+                    final_sum = None
+                    model.failures.append(
+                        "no leader after heal: cluster wedged")
+                if final_sum is not None:
+                    deadline = time.monotonic() + 60
+                    laggards = [n for _, n in cluster]
+                    while time.monotonic() < deadline and laggards:
+                        laggards = [
+                            n for n in laggards
+                            if _fifo_summary(
+                                coords[n].by_name[gname].machine_state)
+                            != final_sum
+                        ]
+                        if laggards:
+                            time.sleep(0.2)
+                    for n in laggards:
+                        model.failures.append(
+                            f"replica {n} never converged")
+                counts["fifo_redeliveries"] = fifo.redeliveries
+                counts["fifo_settled"] = len(fifo.settled)
+            else:
+                final = None
+                deadline = time.monotonic() + 30
+                kick_at = time.monotonic()
+                while time.monotonic() < deadline:
+                    try:
+                        out = api.consistent_query(
+                            cluster[0], lambda s: dict(s),
+                            timeout=op_timeout)
+                        final = out[1]
+                        break
+                    except Exception:  # noqa: BLE001
+                        if rescue and time.monotonic() - kick_at > 3:
+                            kick()
+                            kick_at = time.monotonic()
+                        time.sleep(0.2)
+                if final is None:
+                    model.failures.append(
+                        "no leader after heal: cluster wedged")
                 else:
-                    heal()
-            elif roll < 0.96 and disk_faults:
-                counts["disk_fault"] = counts.get("disk_fault", 0) + 1
-                site, action, trigger = rng.choice(_DISK_FAULT_MENU)
-                faults.arm(site, action, trigger,
-                           seed=rng.randrange(1 << 30),
-                           scope=rng.choice(names[:nodes]))
-            elif membership and partitioned is None:
-                counts["membership"] = counts.get("membership", 0) + 1
-                try:
-                    if spare in cluster:
-                        out = api.remove_member(cluster[0], spare,
-                                                timeout=op_timeout)
-                        if out[0] == "ok":
-                            cluster.remove(spare)
-                    else:
-                        coords[spare[1]].add_group(
-                            gname, f"kvbc{seed}", cluster + [spare], DictKv(),
-                            log=mk_log(spare[1]) if use_disk else None,
+                    model.check_state(final, "final consistent read")
+                    deadline = time.monotonic() + 60  # generous on loaded hosts
+                    laggards = [n for _, n in cluster]  # current members only
+                    want = _stable(final)
+                    while time.monotonic() < deadline and laggards:
+                        laggards = [
+                            n for n in laggards
+                            if _stable(coords[n].by_name[gname].machine_state)
+                            != want
+                        ]
+                        if laggards:
+                            time.sleep(0.2)
+                    for n in laggards:
+                        g = coords[n].by_name[gname]
+                        model.failures.append(
+                            f"replica {n} never converged: role={g.role} "
+                            f"term={g.term} applied={g.last_applied} "
+                            f"members={g.members} state_keys="
+                            f"{sorted(g.machine_state)[:6]} vs final_keys="
+                            f"{sorted(final)[:6]}"
                         )
-                        out = api.add_member(cluster[0], spare,
-                                             timeout=op_timeout)
-                        if out[0] == "ok":
-                            cluster.append(spare)
-                except Exception:  # noqa: BLE001 — change may be rejected
-                    pass
-
-        heal()
-        if use_disk:
-            check_infra()
-        final = None
-        deadline = time.monotonic() + 30
-        kick_at = time.monotonic()
-        while time.monotonic() < deadline:
-            try:
-                out = api.consistent_query(cluster[0], lambda s: dict(s),
-                                           timeout=op_timeout)
-                final = out[1]
-                break
-            except Exception:  # noqa: BLE001
-                if rescue and time.monotonic() - kick_at > 3:
-                    kick()
-                    kick_at = time.monotonic()
-                time.sleep(0.2)
-        if final is None:
-            model.failures.append("no leader after heal: cluster wedged")
-        else:
-            model.check_state(final, "final consistent read")
-            deadline = time.monotonic() + 60  # generous on loaded hosts
-            laggards = [n for _, n in cluster]  # current members only
-            while time.monotonic() < deadline and laggards:
-                laggards = [
-                    n for n in laggards
-                    if coords[n].by_name[gname].machine_state != final
-                ]
-                if laggards:
-                    time.sleep(0.2)
-            for n in laggards:
-                g = coords[n].by_name[gname]
-                model.failures.append(
-                    f"replica {n} never converged: role={g.role} "
-                    f"term={g.term} applied={g.last_applied} "
-                    f"members={g.members} state_keys="
-                    f"{sorted(g.machine_state)[:6]} vs final_keys="
-                    f"{sorted(final)[:6]}"
-                )
-        if overload and not model.failures:
-            _overload_phase(model, cluster, op_timeout, counts, seed)
+                    flood = final.get(_BURST_KEY, 0)
+                    if flood > burst_sent[0]:
+                        model.failures.append(
+                            f"overload bursts: {_BURST_KEY}={flood} > "
+                            f"{burst_sent[0]} delivered — duplicated "
+                            f"ack-free commands")
+            if overload and workload == "kv" and not model.failures:
+                _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         anomalies = _capture_health(model.failures)
         if disk_faults:
@@ -879,11 +1427,14 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
             shutil.rmtree(base, ignore_errors=True)
         leaderboard.clear()
+    nem_counts = {k: v - ctr0.get(k, 0)
+                  for k, v in planner.counters().items()}
     _dump_on_failure(model.failures, f"batch seed={seed}",
-                     anomalies=anomalies)
+                     anomalies=anomalies, planner=planner)
     return HarnessResult(
         consistent=not model.failures, failures=model.failures,
-        ops=counts, final_model=dict(model.sure),
+        ops=counts, final_model=dict(model.sure), nemesis=nem_counts,
+        schedule=list(planner.schedule),
     )
 
 
@@ -895,6 +1446,15 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ops", type=int, default=500)
     ap.add_argument("--backend", default="per_group_actor")
+    ap.add_argument("--workload", choices=("kv", "fifo"), default="kv",
+                    help="machine under test: the DictKv map or the "
+                         "FifoMachine queue with its settle-conservation "
+                         "checker")
+    ap.add_argument("--combined", action="store_true",
+                    help="the combined-fault soak: every nemesis "
+                         "dimension at once (incl. one-way partitions, "
+                         "overload bursts, batch mode flips), scheduled "
+                         "by the planner's own seeded rng")
     ap.add_argument("--disk-faults", action="store_true",
                     help="enable the seeded storage-nemesis dimension "
                          "(failpoint storms; WAL-backed logs on tpu_batch)")
@@ -916,8 +1476,12 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
               restarts=args.restarts, disk_faults=args.disk_faults,
-              overload=args.overload, rings=args.rings == "on")
+              overload=args.overload, rings=args.rings == "on",
+              workload=args.workload, combined=args.combined)
     print(f"ops={res.ops} consistent={res.consistent}")
+    if res.nemesis:
+        fired = {k: v for k, v in res.nemesis.items() if v}
+        print(f"nemesis={fired}")
     for f in res.failures:
         print("FAILURE:", f)
     sys.exit(0 if res.consistent else 1)

@@ -122,7 +122,11 @@ class FifoMachine(Machine):
             if cid in st.service_queue:
                 st.service_queue.remove(cid)
             if inflight:
-                for msg_id, msg in sorted(inflight.items()):
+                # requeue at the FRONT in original order: appendleft
+                # reverses, so walk the ids highest-first — the lowest
+                # msg_id must end up at the head or a multi-message down
+                # (prefetch > 1) redelivers out of FIFO order
+                for msg_id, msg in sorted(inflight.items(), reverse=True):
                     st.queue.appendleft((msg_id, msg))
                 self._service(st, effects)
             return st, ("ok", None), effects

@@ -261,6 +261,16 @@ class RaNode:
                     "machine_upgrade_strategy",
                     self.config.machine_upgrade_strategy,
                 ),
+                # check-quorum default: generous vs both the election
+                # timeout (a connected follower's ack cadence) and the
+                # tick (our own evaluation cadence), so only a genuinely
+                # silent quorum — the one-way-partition stale-leader
+                # shape — trips a step-down
+                check_quorum_window_s=extra.get(
+                    "check_quorum_window_s",
+                    max(6 * self.election_timeout_s,
+                        10 * self.tick_interval_s),
+                ),
             )
             server = Server(cfg, log, self.meta)
             server.recover()

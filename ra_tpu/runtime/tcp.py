@@ -76,7 +76,16 @@ class _Peer:
 
 class TcpTransport:
     """Duck-type compatible with InProcTransport (send / node_alive /
-    proc_alive / blocked set for fault injection)."""
+    proc_alive / blocked set for fault injection).
+
+    The ``blocked`` set holds DIRECTED ``(from, to)`` node pairs checked
+    on the sender's side only, so the nemesis plane's one-way partitions
+    (``testing.partition_oneway`` / the soak's ``oneway`` dimension) work
+    identically over TCP: arming ``(a, b)`` on a's transport drops a's
+    sends to b while b's sends to a still flow — the stale-leader
+    scenario (acks lost, AppendEntries delivered) needs exactly that
+    asymmetry. A symmetric partition arms both directions, each on its
+    own side's transport."""
 
     def __init__(
         self,

@@ -215,6 +215,16 @@ class ServerConfig:
     # pre_vote on by default; candidates skip straight to request_vote
     # when False.
     pre_vote: bool = True
+    # check-quorum window (seconds; 0 disables): a leader that has not
+    # HEARD from a quorum of voters within the window steps down and
+    # answers its pending clients "maybe" instead of reigning uselessly.
+    # This is the one-way-partition guard: a leader whose AppendEntries
+    # still flow OUT keeps resetting follower election timers, so no
+    # follower ever stands — only the leader itself can notice that no
+    # ack ever comes BACK (Raft §6's check-quorum / the reference's
+    # leader contact monitoring). Node construction defaults it from
+    # the node's timing config (runtime/node.py).
+    check_quorum_window_s: float = 0.0
     machine_config: Optional[Dict[str, Any]] = None
     # "all" (default): bump the effective machine version only once every
     # member supports it; "quorum": once a quorum does (reference:
@@ -266,6 +276,11 @@ class Server:
         self.pre_votes: Set[ServerId] = set()
         self.pre_vote_token: int = 0
         self._token_counter: int = 0
+        # check-quorum bookkeeping: monotonic stamp of the last message
+        # RECEIVED from each peer while we lead (any inbound message is
+        # contact — AER replies, heartbeat replies, snapshot results,
+        # votes); evaluated against cfg.check_quorum_window_s per tick
+        self._peer_contact: Dict[ServerId, float] = {}
 
         # consistent-query state (leader side)
         self.query_index: int = 0
@@ -593,12 +608,16 @@ class Server:
     def _become_leader(self, effects: EffectList) -> None:
         self.leader_id = self.id
         last_idx, _ = self.log.last_index_term()
+        now = time.monotonic()
         for sid, p in self.cluster.items():
             if sid != self.id:
                 p.next_index = last_idx + 1
                 p.match_index = 0
                 p.commit_index_sent = 0
                 p.status = "normal"
+                # check-quorum grace: a fresh leader owes every peer a
+                # full window before their silence can depose it
+                self._peer_contact[sid] = now
         self.cluster_change_permitted = False
         self.pending_cluster_change = None
         self.query_index = 0
@@ -630,6 +649,9 @@ class Server:
 
     def _handle_leader(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
         effects: EffectList = []
+        if from_peer is not None and from_peer in self.cluster:
+            # ANY inbound message from a member is check-quorum contact
+            self._peer_contact[from_peer] = time.monotonic()
         if isinstance(msg, Command):
             self._c("commands")
             self._append_leader(msg, effects)
@@ -1015,6 +1037,22 @@ class Server:
         return effects
 
     def _leader_tick(self, msg: Tick, effects: EffectList) -> EffectList:
+        if self._check_quorum_lost():
+            # check-quorum: no quorum of voters has been HEARD within
+            # the window — one-way partitions leave our AERs flowing
+            # out (so no follower ever times out) while nothing comes
+            # back. Step down: _become answers every pending client
+            # "maybe" immediately (no wedged clients) and the now-
+            # silent followers elect a connected leader.
+            self._c("check_quorum_stepdowns")
+            self._obs_rec.record(
+                "check_quorum_stepdown", node=self.id[1], group=self.id[0],
+                term=self.current_term,
+                detail=f"quorum silent > {self.cfg.check_quorum_window_s}s",
+            )
+            self.leader_id = None
+            self._become_follower(effects, leader=None)
+            return effects
         # persist last_applied so effects are not re-issued on recovery
         # (reference: persist_last_applied src/ra_server.erl:2540-2567)
         self.meta.store(self.cfg.uid, "last_applied", self.last_applied)
@@ -1062,6 +1100,23 @@ class Server:
         self._maybe_upgrade_machine(effects)
         self._pipeline(effects, force_commit_sync=True)
         return effects
+
+    def _check_quorum_lost(self) -> bool:
+        """True when check-quorum is enabled and no quorum of voters
+        (self included) has been heard within the window. Peers never
+        seen before (fresh joins) count as just-contacted so a
+        membership change cannot depose a healthy leader."""
+        win = self.cfg.check_quorum_window_s
+        if win <= 0:
+            return False
+        now = time.monotonic()
+        live = 1 if self.is_voter_self() else 0
+        for sid, p in self.cluster.items():
+            if sid == self.id or not p.is_voter():
+                continue
+            if now - self._peer_contact.setdefault(sid, now) <= win:
+                live += 1
+        return live < self.required_quorum()
 
     def _required_machine_version(self) -> int:
         """The version the upgrade strategy currently allows (never below

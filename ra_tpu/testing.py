@@ -40,6 +40,23 @@ def partition(minority: Sequence[str], rest: Sequence[str]) -> None:
             _block_pair(a, b)
 
 
+def partition_oneway(a: str, b: str) -> None:
+    """Asymmetric partition: ``a``'s sends to ``b`` are dropped while
+    ``b -> a`` (and every other direction) stays up. The transports'
+    ``blocked`` sets are already directional (``InProcTransport`` /
+    ``TcpTransport`` check ``(from, to)`` on send), so this only arms
+    one side of what ``partition`` arms.
+
+    The canonical use is the stale-leader scenario: block each
+    follower's path BACK to the leader and the leader keeps streaming
+    AppendEntries (resetting follower election timers) while never
+    hearing an ack — without check-quorum (server.py leader tick) it
+    would reign uselessly forever and wedge every client on it."""
+    na = node_registry().get(a)
+    if na is not None:
+        na.transport.block(a, b)
+
+
 def crash_thread(node: str, which: str) -> None:
     """Arm a one-shot thread-crash failpoint against ``node``'s WAL or
     segment-writer loop (``which`` in {"wal", "segment_writer"}). The
@@ -60,6 +77,7 @@ def run_scenario(script: List[Tuple], api_mod=None) -> None:
 
     ("part", [nodes...], [other nodes...], seconds) — partition then heal
     ("part_hold", [nodes...], [other nodes...])     — partition, no heal
+    ("part_oneway", a, b)                           — drop a->b only
     ("wait", seconds)
     ("restart", [server_ids...])                    — restart server procs
     ("heal",)
@@ -80,6 +98,9 @@ def run_scenario(script: List[Tuple], api_mod=None) -> None:
         elif op == "part_hold":
             _, minority, rest = step
             partition(minority, rest)
+        elif op == "part_oneway":
+            _, a, b = step
+            partition_oneway(a, b)
         elif op == "wait":
             time.sleep(step[1])
         elif op == "restart":

@@ -200,6 +200,28 @@ def main() -> int:
         while time.time() < deadline and not _health_ready():
             time.sleep(0.05)
 
+        # nemesis plane (docs/INTERNALS.md §17): drive one dimension
+        # through a stub context so the per-dimension injected/healed
+        # counter family is present AND nonzero in the scrape — the
+        # soak's coverage asserts read these same counters
+        from ra_tpu import nemesis as nem
+
+        _nem_blocked: list = []
+        _nem_ctx = nem.NemesisContext(
+            peers=lambda: ["na", "nb", "nc"],
+            members=lambda: ["na", "nb", "nc"],
+            block=lambda a, b: _nem_blocked.append((a, b)),
+            unblock_all=_nem_blocked.clear,
+        )
+        with nem.Planner(_nem_ctx, 1, "obs_smoke",
+                         nem.standard_dimensions()) as _nem_pl:
+            _nem_pl.fire("partition", _nem_pl.rng)
+            _nem_pl.heal_transient("smoke")
+        if len(_nem_pl.schedule) < 2:
+            errors.append("nemesis planner recorded no inject/heal schedule")
+        if _nem_blocked:
+            errors.append("nemesis heal left one-sided blocks armed")
+
         text = api.prometheus_metrics()
         required_live = required_bench + [
             r"# TYPE ra_commit_rate gauge",
@@ -237,6 +259,18 @@ def main() -> int:
             r"ra_health_fetches\{[^}]*obs0[^}]*\} (\d+)",
             r"# TYPE ra_health_stuck gauge",
             r"ra_health_quiet\{[^}]*obs0[^}]*\} (\d+)",
+            # nemesis plane (docs/INTERNALS.md §17): the stub planner
+            # above fired + healed a partition, so those two must be
+            # nonzero; the other dimensions gate on family presence
+            r"ra_nemesis_partition_injected\{[^}]*obs_smoke[^}]*\} (\d+)",
+            r"ra_nemesis_partition_healed\{[^}]*obs_smoke[^}]*\} (\d+)",
+            r"# TYPE ra_nemesis_oneway_injected counter",
+            r"# TYPE ra_nemesis_disk_injected counter",
+            r"# TYPE ra_nemesis_crash_injected counter",
+            r"# TYPE ra_nemesis_membership_injected counter",
+            r"# TYPE ra_nemesis_overload_injected counter",
+            r"# TYPE ra_nemesis_modeflip_injected counter",
+            r"# TYPE ra_nemesis_heals_forced counter",
         ]
         _check_exposition(text, errors, required_live)
 

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Combined-fault soak: the slow job that runs AFTER the tier-1 gate,
+# next to scripts/flake_gate.sh.
+#
+# Phase 1 runs the pinned soak grid (tests/test_soak.py -m soak:
+# 3 seeds x 2 backends x 2 workloads, every nemesis dimension armed at
+# once). Phase 2 is the flake gate over FRESH seeds: N extra combined
+# runs per backend straight through the harness, so a liveness or
+# conservation bug outside the pinned seeds still gets caught. Any
+# failure prints the repro bundle (seed, nemesis schedule, flight
+# recorder, health anomalies) on stderr — rerun a single seed with:
+#
+#   python -m ra_tpu.kv_harness --combined --seed N [--backend tpu_batch]
+#
+# Usage: scripts/soak.sh [N_EXTRA_SEEDS] [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+N="${1:-5}"
+shift || true
+
+echo "== soak: pinned grid (3 seeds x 2 backends x 2 workloads) =="
+python -m pytest tests/test_soak.py -q -m soak \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "== soak: flake gate over $N fresh seeds per backend =="
+for seed in $(seq 100 $((99 + N))); do
+    for backend in per_group_actor tpu_batch; do
+        for workload in kv fifo; do
+            echo "-- seed=$seed backend=$backend workload=$workload"
+            python -m ra_tpu.kv_harness --combined --seed "$seed" \
+                --ops 200 --backend "$backend" --workload "$workload" \
+                >/tmp/soak_run.log 2>&1 \
+                || { echo "soak FAILED: seed=$seed backend=$backend" \
+                          "workload=$workload"; \
+                     tail -60 /tmp/soak_run.log; exit 1; }
+        done
+    done
+done
+echo "soak: PASS"

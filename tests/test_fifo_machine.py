@@ -1,0 +1,201 @@
+"""FifoMachine property tests against an in-process oracle.
+
+The oracle tracks message CONSERVATION, not mechanism: every enqueued
+payload must be delivered at least once and settled exactly once by the
+end of a full drain, nothing may be delivered that was never enqueued,
+and a payload must never surface under two msg_ids (an enqueue applied
+twice). On top of the random folds, deterministic regressions pin the
+parts randomness reaches rarely: redelivery ORDER after a consumer
+``down`` with prefetch > 1, and the purge / release-cursor interaction.
+
+Replica determinism rides along: the same command sequence is folded on
+three independent machine instances and must produce identical states
+and identical effect streams at every step (the ra_props_SUITE shape,
+here at the machine layer where it is exhaustive and fast).
+"""
+
+import random
+
+import pytest
+
+from ra_tpu.effects import ReleaseCursor, SendMsg
+from ra_tpu.models.fifo import FifoMachine
+
+
+def _meta(i):
+    return {"index": i, "term": 1, "machine_version": 0}
+
+
+def _fingerprint(st):
+    return (st.next_msg_id, tuple(st.queue),
+            tuple(sorted((c, tuple(sorted(f.items())))
+                         for c, f in st.consumers.items())),
+            tuple(sorted(st.prefetch.items())),
+            tuple(st.service_queue))
+
+
+def _deliveries(effs):
+    return [e.msg for e in effs
+            if isinstance(e, SendMsg) and e.msg and e.msg[0] == "delivery"]
+
+
+class _Oracle:
+    """Conservation bookkeeping, independent of the machine's internals."""
+
+    def __init__(self):
+        self.enqueued = {}        # msg_id -> payload (in enqueue order)
+        self.delivered = {}       # msg_id -> count
+        self.settled = set()
+        self.inflight = {}        # cid -> set of msg_ids (from deliveries)
+
+    def observe(self, cmd, reply, effs):
+        # record the enqueue FIRST: a waiting consumer gets its delivery
+        # effect in the very same apply
+        if (isinstance(cmd, tuple) and cmd and cmd[0] == "enqueue"
+                and reply and reply[0] == "ok"):
+            self.enqueued[reply[1]] = cmd[1]
+        for _, msg_id, payload in _deliveries(effs):
+            assert msg_id in self.enqueued, \
+                f"delivered msg_id {msg_id} was never enqueued"
+            assert self.enqueued[msg_id] == payload, \
+                f"msg_id {msg_id} delivered with the wrong payload"
+            assert msg_id not in self.settled, \
+                f"settled msg_id {msg_id} redelivered"
+            self.delivered[msg_id] = self.delivered.get(msg_id, 0) + 1
+        if not (isinstance(cmd, tuple) and cmd):
+            return
+        op = cmd[0]
+        # track who holds what, from the delivery effects themselves
+        for e in effs:
+            if isinstance(e, SendMsg) and e.msg and e.msg[0] == "delivery":
+                self.inflight.setdefault(e.to, set()).add(e.msg[1])
+        if op == "settle":
+            self.inflight.get(cmd[1], set()).discard(cmd[2])
+            self.settled.add(cmd[2])
+        elif op == "return":
+            self.inflight.get(cmd[1], set()).discard(cmd[2])
+        elif op in ("down", "cancel"):
+            self.inflight.pop(cmd[1], None)
+
+
+@pytest.mark.parametrize("seed", [2, 9, 17, 40])
+def test_fifo_random_ops_conserve_and_converge(seed):
+    rng = random.Random(seed)
+    machines = [FifoMachine() for _ in range(3)]
+    states = [m.init({}) for m in machines]
+    oracle = _Oracle()
+    cids = ["c0", "c1", "c2"]
+    idx = 0
+
+    def apply(cmd):
+        nonlocal idx, states
+        idx += 1
+        outs = [m.apply(_meta(idx), cmd, st)
+                for m, st in zip(machines, states)]
+        states = [o[0] for o in outs]
+        fps = {_fingerprint(st) for st in states}
+        assert len(fps) == 1, f"replicas diverged after {cmd!r}"
+        replies = {repr(o[1]) for o in outs}
+        assert len(replies) == 1, f"replies diverged after {cmd!r}"
+        effs = {repr(o[2]) for o in outs}
+        assert len(effs) == 1, f"effects diverged after {cmd!r}"
+        oracle.observe(cmd, outs[0][1], outs[0][2])
+        return outs[0]
+
+    for i in range(300):
+        r = rng.random()
+        if r < 0.40:
+            apply(("enqueue", f"p{seed}_{i}"))
+        elif r < 0.55:
+            apply(("checkout", rng.choice(cids), rng.choice((1, 2, 3, 5))))
+        elif r < 0.75:
+            cands = [(c, m) for c, mm in oracle.inflight.items()
+                     for m in mm if c in cids]
+            if cands:
+                apply(("settle", *cands[rng.randrange(len(cands))]))
+        elif r < 0.85:
+            cands = [(c, m) for c, mm in oracle.inflight.items()
+                     for m in mm if c in cids]
+            if cands:
+                apply(("return", *cands[rng.randrange(len(cands))]))
+        elif r < 0.93:
+            apply(("down", rng.choice(cids), "crash"))
+        else:
+            apply(("settle", rng.choice(cids), 10_000))  # idempotent no-op
+
+    # full drain through a wide-credit consumer: every enqueued message
+    # must come out and settle exactly once
+    for cid in cids:
+        apply(("down", cid, "teardown"))
+    _, _, effs = apply(("checkout", "drain", 100_000))
+    seen_release = False
+    for _ in range(len(oracle.enqueued) + 5):
+        todo = sorted(oracle.inflight.get("drain", set()))
+        if not todo:
+            break
+        for mid in todo:
+            _, _, effs = apply(("settle", "drain", mid))
+            seen_release = seen_release or any(
+                isinstance(e, ReleaseCursor) for e in effs)
+    st = states[0]
+    assert not st.queue and all(not f for f in st.consumers.values()), \
+        "drain left messages behind"
+    undelivered = set(oracle.enqueued) - set(oracle.delivered)
+    assert not undelivered, f"enqueued but never delivered: {undelivered}"
+    unsettled = set(oracle.enqueued) - oracle.settled
+    assert not unsettled, f"delivered but never settled: {unsettled}"
+    if oracle.enqueued:
+        assert seen_release, \
+            "drained to empty but no settle emitted a ReleaseCursor"
+
+
+def test_fifo_down_with_prefetch_redelivers_in_order():
+    """Regression: a consumer dying with SEVERAL messages in flight must
+    requeue them at the head in original order — msg 1 before msg 2
+    before msg 3 — not reversed (the appendleft fold reverses unless the
+    ids are walked highest-first)."""
+    m = FifoMachine()
+    st = m.init({})
+    for i, p in enumerate(("m1", "m2", "m3"), start=1):
+        st, r, _ = m.apply(_meta(i), ("enqueue", p), st)
+        assert r == ("ok", i)
+    st, _, effs = m.apply(_meta(4), ("checkout", "c1", 3), st)
+    assert [d[1] for d in _deliveries(effs)] == [1, 2, 3]
+    st, _, _ = m.apply(_meta(5), ("down", "c1", "crash"), st)
+    assert [mid for mid, _ in st.queue] == [1, 2, 3], \
+        f"requeue reversed the in-flight order: {list(st.queue)}"
+    st, _, effs = m.apply(_meta(6), ("checkout", "c2", 3), st)
+    assert [d[1] for d in _deliveries(effs)] == [1, 2, 3], \
+        "redelivery after down must preserve FIFO order"
+
+
+def test_fifo_down_interleaves_with_ready_queue():
+    """Requeued in-flight messages go to the FRONT — ahead of younger
+    ready messages — so a crash never demotes old messages to the back."""
+    m = FifoMachine()
+    st = m.init({})
+    st, _, _ = m.apply(_meta(1), ("enqueue", "old"), st)
+    st, _, effs = m.apply(_meta(2), ("checkout", "c1", 1), st)
+    assert [d[1] for d in _deliveries(effs)] == [1]
+    st, _, _ = m.apply(_meta(3), ("enqueue", "young"), st)
+    st, _, _ = m.apply(_meta(4), ("down", "c1", "crash"), st)
+    assert [mid for mid, _ in st.queue] == [1, 2]
+
+
+def test_fifo_purge_release_cursor_interaction():
+    """Purge drops READY messages only; the ReleaseCursor is emitted iff
+    nothing is in flight either (live in-flight state still needs the
+    log to rebuild it)."""
+    m = FifoMachine()
+    st = m.init({})
+    for i in range(1, 4):
+        st, _, _ = m.apply(_meta(i), ("enqueue", f"m{i}"), st)
+    st, _, effs = m.apply(_meta(4), ("checkout", "c1", 1), st)
+    assert [d[1] for d in _deliveries(effs)] == [1]
+    st, r, effs = m.apply(_meta(5), ("purge",), st)
+    assert r == ("ok", 2), "purge must report the READY count it dropped"
+    assert not any(isinstance(e, ReleaseCursor) for e in effs), \
+        "ReleaseCursor with a message still in flight"
+    st, _, effs = m.apply(_meta(6), ("settle", "c1", 1), st)
+    assert any(isinstance(e, ReleaseCursor) for e in effs), \
+        "queue and in-flight both empty: settle must emit ReleaseCursor"

@@ -125,6 +125,88 @@ def test_leader_minority_cannot_commit_during_partition(cluster):
     assert converged(ids, 1)
 
 
+def test_stale_leader_steps_down_on_oneway_partition(cluster):
+    """Asymmetric partition (the nemesis plane's ``oneway`` dimension):
+    both followers' paths BACK to the leader are cut while the leader's
+    sends still land. The leader keeps streaming AppendEntries —
+    resetting every follower election timer — but never hears an ack,
+    so without check-quorum it would reign uselessly forever and every
+    client pinned to it would wedge. Asserts the leader steps down via
+    check-quorum (bounded client error, counter fires) and a follower
+    then wins the election while the one-way blocks are still up."""
+    from ra_tpu import counters as ra_counters
+
+    ids = cluster
+    api.start_cluster("sl", lambda: SimpleMachine(lambda c, s: s + c, 0), ids)
+
+    def stepdowns():
+        return sum(v.get("check_quorum_stepdowns", 0)
+                   for v in ra_counters.overview().values())
+
+    def role_of(sid):
+        fut = api.Future()
+        api._try_send(sid, ("state_query", lambda s: s.role, fut))
+        try:
+            return fut.result(2)[1]
+        except Exception:
+            return None
+
+    # elections churn at these tight timings: arm the blocks, then
+    # verify the victim still thinks it leads (once EVERY inbound path
+    # is cut it can never learn a newer term, so a stale leader stays
+    # "leader" until check-quorum) — retry if leadership had moved
+    for _ in range(4):
+        leader = api.wait_for_leader("sl")
+        _, hint = api.process_command(leader, 1, timeout=10,
+                                      retry_on_timeout=True)
+        if hint is not None and hint != leader:
+            leader = hint
+        base = stepdowns()
+        lnode = leader[1]
+        for f in [sid for sid in ids if sid[1] != lnode]:
+            testing.partition_oneway(f[1], lnode)
+        if role_of(leader) == "leader":
+            break
+        testing.heal_all()  # leadership had already moved; re-pin
+    else:
+        pytest.fail("could not pin the one-way partition on the live leader")
+
+    # a client on the stale leader must not wedge: completion is BOUNDED
+    # — either the reroute to the new leader commits it or check-quorum
+    # answers the pending reply with an error at step-down (~1s window)
+    t0 = time.monotonic()
+    try:
+        api.process_command(leader, 100, timeout=15)
+    except api.RaError:
+        pass
+    assert time.monotonic() - t0 < 10, "client wedged on the stale leader"
+
+    # the followers (whose detectors see their ack path dead) elect a
+    # new leader the stale one never hears about...
+    deadline = time.monotonic() + 15
+    new_leader = None
+    while time.monotonic() < deadline:
+        lead = leaderboard.lookup_leader("sl")
+        if lead is not None and lead[1] != lnode and role_of(lead) == "leader":
+            new_leader = lead
+            break
+        time.sleep(0.05)
+    assert new_leader is not None, "no follower took over from the stale leader"
+    r, _ = api.process_command(new_leader, 10, timeout=20, retry_on_timeout=True)
+    assert isinstance(r, int), f"command through the new leader failed: {r!r}"
+
+    # ...and since every inbound path to the stale leader is cut, CHECK-
+    # QUORUM is its only way down: it must step down on its own, not
+    # reign at the old term forever
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if stepdowns() > base and role_of(leader) != "leader":
+            break
+        time.sleep(0.05)
+    assert stepdowns() > base, "stale leader never fired check-quorum"
+    assert role_of(leader) != "leader", "stale leader still reigning"
+
+
 # ---------------------------------------------------------------------------
 # property: replicated-log determinism with non-associative ops
 
