@@ -81,7 +81,7 @@ def _retry_on_cpu_or_fail() -> None:
 
 def bench_pipeline(groups: int, cmds: int, wal: bool = True,
                    workdir: str = None, pipeline="on",
-                   rings: str = "on") -> dict:
+                   rings: str = "on", native: str = "auto") -> dict:
     """Multi-raft pipeline bench. Modes (``pipeline``):
 
     - ``"on"`` (default): the pipelined wave loop in its cooperative
@@ -138,6 +138,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             )
             _retry_on_cpu_or_fail()  # backend is non-cpu here: re-execs
 
+    from ra_tpu import native as _ra_native
     from ra_tpu.models.bench_machine import BenchMachine
     from ra_tpu.ops import consensus as C
     from ra_tpu.protocol import Command, ElectionTimeout, USR
@@ -146,7 +147,7 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
     coords = [
         BatchCoordinator(f"bench{i}", capacity=groups, num_peers=3,
                          idle_sleep_s=0, pipeline=pipeline != "off",
-                         rings=rings == "on")
+                         rings=rings == "on", native=native)
         for i in range(3)
     ]
     storage = []
@@ -693,6 +694,21 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             ),
             "pipeline": pipeline,
             "rings": rings,
+            # native hot-loop runtime (docs/INTERNALS.md §18): what was
+            # requested, what actually loaded, and per-path activity —
+            # the artifact is self-describing about which native entry
+            # points the number was measured with
+            "native": native,
+            "native_entry_points": _ra_native.entry_points(),
+            "native_counters": {
+                k: int(sum(c.counters.get(k) for c in coords))
+                for k in (
+                    "native_classify_batches", "native_classify_items",
+                    "native_pack_batches", "native_pack_msgs",
+                    "native_egress_batches", "native_egress_frames",
+                    "native_fallbacks",
+                )
+            },
             "ring_counters": {
                 k: int(sum(c.counters.get(k) for c in coords))
                 for k in (
@@ -834,6 +850,11 @@ def main() -> None:
                          "rings + event-driven wakeups; off: the "
                          "lock+deque control command plane (same-box "
                          "A/B is this one flag)")
+    ap.add_argument("--native", default="auto",
+                    help="native hot-loop runtime paths: auto/on/all "
+                         "(default), off/none, or a comma list of "
+                         "pack,classify,egress (per-entry-point "
+                         "ablation; docs/INTERNALS.md §18)")
     args = ap.parse_args()
 
     ensure_live_backend()
@@ -849,7 +870,8 @@ def main() -> None:
         g = args.groups or (128 if args.smoke else 10240)
         out = bench_pipeline(g, args.cmds or (3 if args.smoke else 96),
                              wal=not args.no_wal, workdir=args.workdir,
-                             pipeline=args.pipeline, rings=args.rings)
+                             pipeline=args.pipeline, rings=args.rings,
+                             native=args.native)
     print(json.dumps(out))
 
 

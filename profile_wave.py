@@ -16,6 +16,12 @@ they are listed for attribution, not added to the share denominator.
 
 Usage: PYTHONPATH= JAX_PLATFORMS=cpu python profile_wave.py
        [groups] [cmds] [--top N] [--cprofile] [--trace out.json]
+       [--native on|off|both]
+
+``--native both`` runs the native hot-loop runtime pass and the Python
+control back to back (histograms reset between) and prints both phase
+tables plus the throughput/latency comparison line — the per-round
+verification surface for docs/INTERNALS.md §18.
 
 ``--trace out.json`` additionally records every wave phase as a
 timeline span and dumps Chrome/Perfetto trace JSON (load in
@@ -127,8 +133,21 @@ def phase_tables(nodes, top: int = 5) -> str:
     return "\n".join(tables)
 
 
+def _reset_wave_histograms() -> None:
+    """Zero every live histogram so a second in-process bench run's
+    attribution tables read only its own samples (the --native both
+    comparison runs two benches back to back)."""
+    from ra_tpu import obs
+
+    reg = obs.histograms()
+    for name in reg.names():
+        h = reg.fetch(name)
+        if h is not None:
+            h.reset()
+
+
 def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None,
-         pipeline="on") -> None:
+         pipeline="on", native="on") -> None:
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from bench import bench_pipeline
@@ -140,38 +159,61 @@ def main(groups=2048, cmds=24, top=5, cprofile=False, trace=None,
         from ra_tpu import obs
 
         obs.trace_buffer().enable()
-    t0 = time.perf_counter()
-    pr = None
-    if cprofile:
-        import cProfile
+    # --native both: the A/B attribution pair — the native hot-loop
+    # runtime run first, then the Python control, each with its own
+    # phase tables (classify_native/pack_native rows appear only in the
+    # native run; ingress_drain/host_pack shrink by what moved native)
+    variants = (
+        [("auto", "native on"), ("off", "native off (control)")]
+        if native == "both"
+        else [("auto" if native == "on" else "off", f"native {native}")]
+    )
+    results = []
+    for native_spec, label in variants:
+        _reset_wave_histograms()
+        t0 = time.perf_counter()
+        pr = None
+        if cprofile:
+            import cProfile
 
-        pr = cProfile.Profile()
-        pr.enable()
-    out = bench_pipeline(groups, cmds, wal=True, pipeline=pipeline)
-    if pr is not None:
-        pr.disable()
-    dt = time.perf_counter() - t0
-    if trace:
-        from ra_tpu import api
+            pr = cProfile.Profile()
+            pr.enable()
+        out = bench_pipeline(groups, cmds, wal=True, pipeline=pipeline,
+                             native=native_spec)
+        if pr is not None:
+            pr.disable()
+        dt = time.perf_counter() - t0
+        if trace:
+            from ra_tpu import api
 
-        n_spans = api.dump_trace(trace)
-        print(f"trace: {n_spans} span events -> {trace} "
-              f"(open in chrome://tracing or ui.perfetto.dev)",
+            n_spans = api.dump_trace(trace)
+            print(f"trace: {n_spans} span events -> {trace} "
+                  f"(open in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
+        print(f"total wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
+              f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms [{label}]",
               file=sys.stderr)
-    print(f"total wall: {dt:.1f}s  result: {out['value']:.0f} cmd/s "
-          f"p50={out['p50_ms']}ms p99={out['p99_ms']}ms", file=sys.stderr)
-    print(f"\n## profile_wave: {groups} groups x {cmds} cmds "
-          f"(WAL-backed, pipeline={pipeline}, {out['value']:.0f} cmd/s, "
-          f"unloaded p50 {out['p50_ms']} ms)\n")
-    print(phase_tables([f"bench{i}" for i in range(3)], top=top))
-    if pr is not None:
-        import io
-        import pstats
+        print(f"\n## profile_wave: {groups} groups x {cmds} cmds "
+              f"(WAL-backed, pipeline={pipeline}, {label}, "
+              f"{out['value']:.0f} cmd/s, "
+              f"unloaded p50 {out['p50_ms']} ms)\n")
+        print(phase_tables([f"bench{i}" for i in range(3)], top=top))
+        results.append((label, out))
+        if pr is not None:
+            import io
+            import pstats
 
-        s = io.StringIO()
-        ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
-        ps.print_stats(45)
-        print(s.getvalue(), file=sys.stderr)
+            s = io.StringIO()
+            ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+            ps.print_stats(45)
+            print(s.getvalue(), file=sys.stderr)
+    if len(results) == 2:
+        (_, on), (_, off) = results
+        ratio = on["value"] / off["value"] if off["value"] else float("inf")
+        print(f"\n### native on vs off: {on['value']:.0f} vs "
+              f"{off['value']:.0f} cmd/s ({ratio:.2f}x), unloaded p50 "
+              f"{on['p50_ms']} vs {off['p50_ms']} ms, native counters "
+              f"{on['native_counters']}")
 
 
 if __name__ == "__main__":
@@ -189,6 +231,12 @@ if __name__ == "__main__":
                     help="wave-loop mode (matches bench.py --pipeline); "
                          "run once with on and once with off for the "
                          "A/B attribution tables")
+    ap.add_argument("--native", choices=("on", "off", "both"),
+                    default="on",
+                    help="native hot-loop runtime (docs/INTERNALS.md "
+                         "§18): both runs the native pass and the "
+                         "Python control back to back and prints the "
+                         "comparison tables")
     args = ap.parse_args(_ARGS)
     main(args.groups, args.cmds, top=args.top, cprofile=args.cprofile,
-         trace=args.trace, pipeline=args.pipeline)
+         trace=args.trace, pipeline=args.pipeline, native=args.native)

@@ -94,6 +94,10 @@ WAL_FIELDS: List[FieldSpec] = [
      "coalescing delay of the last flush (us; 0 = flushed immediately)"),
     ("native_batches", "counter",
      "batches persisted via the native serialize+write+fsync path"),
+    ("native_fallbacks", "counter",
+     "permanent flips off the native path (lib lost or framing format "
+     "mismatch after construction) — nonzero means the Python fallback "
+     "took over mid-run"),
 ]
 
 # Flow-control / liveness counters for a batch coordinator's command
@@ -174,6 +178,26 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
     ("step_spurious_wakeups", "counter",
      "wakeups that found no work (must stay 0 while idle: the "
      "zero-spurious-wakeups invariant of the async command plane)"),
+    # -- native hot-loop runtime (docs/INTERNALS.md §18) ----------------
+    ("native_classify_batches", "counter",
+     "drain passes whose class partition ran in the native GIL-released "
+     "classifier (rt_classify) instead of the per-item Python loop"),
+    ("native_classify_items", "counter",
+     "ring items partitioned by the native classifier"),
+    ("native_pack_batches", "counter",
+     "mailbox builds whose columnwise AER/reply encode ran as one "
+     "native GIL-released scatter (rt_pack_mbox)"),
+    ("native_pack_msgs", "counter",
+     "mailbox messages encoded by the native pack scatter"),
+    ("native_egress_batches", "counter",
+     "per-destination egress batches sealed+framed in one native call "
+     "(rt_seal_frames) on the sender path"),
+    ("native_egress_frames", "counter",
+     "wire frames produced by the native egress sealer"),
+    ("native_fallbacks", "counter",
+     "hot-loop iterations that took the byte-identical Python path "
+     "while a native path was switched on (armed failpoints, "
+     "out-of-range input, or a load failure after the switch)"),
 ]
 
 # Per-node health-plane vector (name ("health", node_name); written

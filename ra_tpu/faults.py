@@ -181,6 +181,16 @@ def any_armed(*sites: str) -> bool:
     return any(s in _armed for s in sites)
 
 
+def anything_armed() -> bool:
+    """True when ANY failpoint is live, regardless of site. The generic
+    native-path gate: paths whose fault surface is the whole item flow
+    (drain-classify, mailbox pack) rather than a named site fall back to
+    Python whenever injection is running at all. Note ``any_armed()``
+    with no sites returns False by design — this is the distinct
+    'is a nemesis active' question."""
+    return bool(_armed)
+
+
 def stats(site: str) -> Tuple[int, int]:
     """(hits, fires) for an armed site; (0, 0) when not armed."""
     fp = _armed.get(site)

@@ -157,6 +157,7 @@ def run(
     rings: bool = True,
     workload: str = "kv",
     combined: bool = False,
+    native: str = "auto",
 ) -> HarnessResult:
     """``rescue=True`` lets the harness fire operator election kicks on
     a stuck deployment (useful when hunting consistency bugs past a
@@ -182,7 +183,11 @@ def run(
     ``rings=False`` runs the batch backend on the lock+deque control
     command plane instead of the lock-free ingress rings (docs/
     INTERNALS.md §16) — the soak's A/B escape hatch; the actor backend
-    ignores it."""
+    ignores it. ``native`` selects the batch coordinator's native
+    hot-loop runtime paths (docs/INTERNALS.md §18; "auto"/"off" or a
+    comma list of pack,classify,egress) — the soak grid runs both so
+    the disk-fault/torn-write failpoints are proven to bite through the
+    native fallback seam."""
     if combined:
         partitions = True
         membership = True
@@ -205,7 +210,7 @@ def run(
                           op_timeout, rescue, restarts=restarts,
                           disk_faults=disk_faults, data_dir=data_dir,
                           overload=overload, rings=rings, workload=workload,
-                          combined=combined)
+                          combined=combined, native=native)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -1015,7 +1020,7 @@ def _dump_on_failure(failures, label: str, anomalies=None,
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
                data_dir=None, overload=False, rings=True, workload="kv",
-               combined=False) -> HarnessResult:
+               combined=False, native="auto") -> HarnessResult:
     import tempfile
 
     from ra_tpu.log.log import Log
@@ -1116,6 +1121,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
             max_command_backlog=(
                 _OVERLOAD_BACKLOG if (overload or combined) else 4096),
             rings=rings,
+            native=native,
             send_msg_cb=fifo_sink,
         )
         if use_disk:
@@ -1473,11 +1479,16 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     ap.add_argument("--rings", choices=("on", "off"), default="on",
                     help="off: batch backend runs the lock+deque "
                          "control command plane (A/B escape hatch)")
+    ap.add_argument("--native", default="auto",
+                    help="batch backend native hot-loop runtime paths: "
+                         "auto (default), off, or a comma list of "
+                         "pack,classify,egress (docs/INTERNALS.md §18)")
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
               restarts=args.restarts, disk_faults=args.disk_faults,
               overload=args.overload, rings=args.rings == "on",
-              workload=args.workload, combined=args.combined)
+              workload=args.workload, combined=args.combined,
+              native=args.native)
     print(f"ops={res.ops} consistent={res.consistent}")
     if res.nemesis:
         fired = {k: v for k, v in res.nemesis.items() if v}

@@ -594,6 +594,7 @@ class Wal:
                 if err is None:
                     if got is None:
                         self._native = False  # lib lost/format miss: fall back
+                        self.counter.incr("native_fallbacks")
                     else:
                         n_bytes, fsync_ns = got
                         self.counter.incr("native_batches")
@@ -688,6 +689,7 @@ class Wal:
             if out is not None:
                 return out
             self._native = False  # build failed: stay on the fallback
+            self.counter.incr("native_fallbacks")
         buf = bytearray()
         for rec in records:
             kind = rec[0]

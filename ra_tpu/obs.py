@@ -258,6 +258,11 @@ WAVE_SUBSET_PHASES = {
     "apply": "subset of host_egress (machine apply, sampled groups)",
     "wal_handoff": "subset of ingress_drain (log.append hand-off, "
                    "sampled groups)",
+    "classify_native": "subset of ingress_drain (GIL-released native "
+                       "class partition of the drained burst; zero "
+                       "samples when the native path is off)",
+    "pack_native": "subset of host_pack (GIL-released native mailbox "
+                   "scatter; zero samples when the native path is off)",
 }
 WAVE_PHASES = WAVE_STEP_PHASES + tuple(WAVE_SUBSET_PHASES.items())
 

@@ -41,7 +41,8 @@ class SpscRing:
     consumer side stays lock-free either way.
     """
 
-    __slots__ = ("capacity", "_mask", "_buf", "_idx", "producer_lock")
+    __slots__ = ("capacity", "_mask", "_buf", "_codes", "_idx",
+                 "producer_lock")
 
     def __init__(self, capacity: int = 8192):
         cap = 1
@@ -50,11 +51,15 @@ class SpscRing:
         self.capacity = cap
         self._mask = cap - 1
         self._buf: List = [None] * cap
+        # class-code sidecar (protocol.RC_*): the flat tagged-item
+        # layout the native drain-classify partition consumes. Written
+        # before the tail publish, like the slot itself.
+        self._codes = bytearray(cap)
         # [0] = head (consumer-owned), [_PAD] = tail (producer-owned)
         self._idx = np.zeros(2 * _PAD, np.int64)
         self.producer_lock: Optional[threading.Lock] = None
 
-    def try_push(self, item) -> bool:
+    def try_push(self, item, code: int = 0) -> bool:
         """Publish one item; False when full (caller handles — never a
         silent drop). The slot store precedes the tail publish, so a
         concurrent pop never reads an unwritten slot."""
@@ -62,14 +67,18 @@ class SpscRing:
         t = int(idx[_PAD])
         if t - int(idx[0]) >= self.capacity:
             return False
-        self._buf[t & self._mask] = item
+        s = t & self._mask
+        self._buf[s] = item
+        self._codes[s] = code
         idx[_PAD] = t + 1
         return True
 
-    def pop_many(self, out: List, limit: Optional[int] = None) -> int:
+    def pop_many(self, out: List, limit: Optional[int] = None,
+                 codes: Optional[bytearray] = None) -> int:
         """Drain up to ``limit`` (default: all) items into ``out`` in
         FIFO order; returns the count. Slots are released (None) before
-        the head publish so the producer never overwrites a live ref."""
+        the head publish so the producer never overwrites a live ref.
+        With ``codes``, the class-code sidecar is appended in step."""
         idx = self._idx
         h = int(idx[0])
         n = int(idx[_PAD]) - h
@@ -79,10 +88,13 @@ class SpscRing:
             return 0
         buf = self._buf
         mask = self._mask
+        cbuf = self._codes
         for k in range(h, h + n):
             s = k & mask
             out.append(buf[s])
             buf[s] = None
+            if codes is not None:
+                codes.append(cbuf[s])
         idx[0] = h + n
         return n
 
@@ -178,16 +190,16 @@ class IngressRings:
             self._local.lane = lane
         return lane
 
-    def publish(self, item) -> bool:
+    def publish(self, item, code: int = 0) -> bool:
         """Push onto this thread's lane; returns False when the lane is
         full (backpressure — the caller decides the policy)."""
         lane = self._lane()
         plock = lane.producer_lock
         if plock is None:
-            ok = lane.try_push(item)
+            ok = lane.try_push(item, code)
         else:
             with plock:
-                ok = lane.try_push(item)
+                ok = lane.try_push(item, code)
         if ok:
             w = self._wake
             if w is not None and not w.is_set():
@@ -196,13 +208,14 @@ class IngressRings:
 
     # -- consumer side ----------------------------------------------------
 
-    def drain(self, out: List) -> int:
+    def drain(self, out: List, codes: Optional[bytearray] = None) -> int:
         """Pop everything from every lane into ``out`` (per-lane FIFO
-        preserved); returns the item count."""
+        preserved); returns the item count. With ``codes``, the class-
+        code sidecar is appended in step with the items."""
         n = 0
         for lane in self._lane_list:
             if len(lane):
-                n += lane.pop_many(out)
+                n += lane.pop_many(out, None, codes)
         return n
 
     def pending(self) -> bool:
@@ -260,22 +273,27 @@ class LockedLanes:
                  max_lanes: Optional[int] = None):
         self._lock = threading.Lock()
         self._q: deque = deque()
+        self._qc: deque = deque()  # class-code sidecar, in step with _q
         self._wake = wake
 
-    def publish(self, item) -> bool:
+    def publish(self, item, code: int = 0) -> bool:
         with self._lock:
             self._q.append(item)
+            self._qc.append(code)
         w = self._wake
         if w is not None and not w.is_set():
             w.set()
         return True
 
-    def drain(self, out: List) -> int:
+    def drain(self, out: List, codes: Optional[bytearray] = None) -> int:
         with self._lock:
             n = len(self._q)
             if n:
                 out.extend(self._q)
                 self._q.clear()
+                if codes is not None:
+                    codes.extend(self._qc)
+                self._qc.clear()
         return n
 
     def pending(self) -> bool:

@@ -53,7 +53,9 @@ import jax
 import jax.numpy as jnp
 
 from ra_tpu import effects as fx
+from ra_tpu import faults
 from ra_tpu import leaderboard
+from ra_tpu import native as _native
 from ra_tpu.log.api import LogApi
 from ra_tpu.log.memory import MemoryLog
 from ra_tpu.machine import Machine, normalize_apply_result
@@ -77,6 +79,12 @@ from ra_tpu.protocol import (
     InstallSnapshotRpc,
     LOSSY_PROTOCOL_TYPES,
     NOOP,
+    RC_BATCH,
+    RC_CMD,
+    RC_CMD_LOW,
+    RC_CMDS,
+    RC_CMDS_LOW,
+    RC_MSG,
     REJECT_OVERLOADED,
     PreVoteResult,
     PreVoteRpc,
@@ -100,6 +108,24 @@ MSG_OF_TYPE = {
     PreVoteRpc: C.MSG_PREVOTE_REQ,
     PreVoteResult: C.MSG_PREVOTE_REPLY,
 }
+
+_NATIVE_PATHS = frozenset(("pack", "classify", "egress"))
+
+
+def parse_native(spec) -> frozenset:
+    """Parse a ``--native`` spec into the set of enabled native
+    hot-loop paths: ``"auto"``/``"on"``/``True`` enable all three,
+    ``"off"``/``"none"``/``False`` none, anything else a comma list
+    over {pack, classify, egress} (docs/INTERNALS.md §18)."""
+    if spec is True or spec in ("auto", "on", "all"):
+        return _NATIVE_PATHS
+    if not spec or spec in ("off", "none"):
+        return frozenset()
+    parts = frozenset(p.strip() for p in str(spec).split(",") if p.strip())
+    unknown = parts - _NATIVE_PATHS
+    if unknown:
+        raise ValueError(f"unknown native paths {sorted(unknown)}")
+    return parts
 
 
 class GroupHost:
@@ -263,6 +289,7 @@ class BatchCoordinator:
         rings: bool = True,
         ingress_ring_slots: int = 8192,
         egress_async: bool = True,
+        native: str = "auto",
     ):
         self.name = node_name
         self.capacity = capacity
@@ -404,7 +431,20 @@ class BatchCoordinator:
         # nested inside any other), folded first by _drain_classify so
         # overflow items keep their arrival seniority.
         self._overflow_q: deque = deque()
+        self._overflow_codes: deque = deque()  # RC_* sidecar, in step
         self._overflow_lock = threading.Lock()
+        # native hot-loop runtime switches (docs/INTERNALS.md §18):
+        # requested paths resolved against what actually loaded. Every
+        # native path keeps the byte-identical Python fallback and
+        # routes around itself while ANY failpoint is armed, so the
+        # nemesis plane always exercises the Python fault seams.
+        paths = parse_native(native)
+        eps = _native.entry_points() if paths else {}
+        self.native = native
+        self._nat_pack = "pack" in paths and eps.get("pack", False)
+        self._nat_classify = "classify" in paths and eps.get("classify", False)
+        self._nat_egress = "egress" in paths and eps.get("egress", False)
+        self._drain_codes = bytearray()  # classify sidecar scratch
         self._low_dirty: set = set()  # gids with buffered low-priority cmds
         # staged device scatters, coalesced ACROSS passes (the host half
         # of the double-buffered staging): appended runs per gid as
@@ -510,29 +550,36 @@ class BatchCoordinator:
         if name not in self.by_name:
             return False
         if type(msg) is Command:
+            # the RC_* class code rides a sidecar slot next to the item
+            # (the flat tagged-item layout): the priority split is paid
+            # once at the producer so the native drain-classify never
+            # touches the object
+            code = RC_CMD_LOW if msg.priority == "low" else RC_CMD
             if msg.internal and self._overflow_q:
                 # older must-deliver work is parked on the overflow
                 # queue: a lane publish would overtake it (the queue
                 # folds after the lane drain) — keep arrival order
-                return self._publish_overflow((self._R_CMD, name, msg))
-            if self._rings.publish((self._R_CMD, name, msg)):
+                return self._publish_overflow((self._R_CMD, name, msg), code)
+            if self._rings.publish((self._R_CMD, name, msg), code):
                 return True
-            return self._ring_full_cmd(name, msg)
+            return self._ring_full_cmd(name, msg, code)
         if type(msg) not in LOSSY_PROTOCOL_TYPES and self._overflow_q:
-            return self._publish_overflow((self._R_MSG, name, from_sid, msg))
-        if self._rings.publish((self._R_MSG, name, from_sid, msg)):
+            return self._publish_overflow(
+                (self._R_MSG, name, from_sid, msg), RC_MSG)
+        if self._rings.publish((self._R_MSG, name, from_sid, msg), RC_MSG):
             return True
         self.counters.incr("ingress_ring_full")
         if type(msg) in LOSSY_PROTOCOL_TYPES:
             return False  # lossy peer traffic: counted drop
-        return self._publish_overflow((self._R_MSG, name, from_sid, msg))
+        return self._publish_overflow((self._R_MSG, name, from_sid, msg), RC_MSG)
 
-    def _ring_full_cmd(self, name: str, msg: Command) -> bool:
+    def _ring_full_cmd(self, name: str, msg: Command,
+                       code: int = RC_CMD) -> bool:
         self.counters.incr("ingress_ring_full")
         if msg.internal:
             # machine-internal must-deliver (timer fires, Append
             # effects): overflow queue, never shed
-            return self._publish_overflow((self._R_CMD, name, msg))
+            return self._publish_overflow((self._R_CMD, name, msg), code)
         if msg.from_ref is not None:
             # explicit backpressure: the command was NEVER enqueued, so
             # a retry is exactly-once safe; the gate waiter wakes the
@@ -546,7 +593,7 @@ class BatchCoordinator:
         self.counters.incr("commands_dropped_overload")
         return False
 
-    def _publish_blocking(self, item) -> bool:
+    def _publish_blocking(self, item, code: int = RC_MSG) -> bool:
         """Bounded-wait publish for must-deliver BULK CLIENT traffic
         (deliver_commands / deliver_many — the producers there are
         client/driver threads, where waiting IS the backpressure): wait
@@ -564,7 +611,7 @@ class BatchCoordinator:
         for _ in range(4):
             if not self.running:
                 return False
-            if self._rings.publish(item):
+            if self._rings.publish(item, code):
                 return True
             self._ring_gate.waiter().wait(0.05)
         # still full after the bounded wait: in cooperative (non-
@@ -572,9 +619,9 @@ class BatchCoordinator:
         # step_* calls — spinning here would livelock until an external
         # stop(). Fall back to the overflow queue: delivered on the
         # next drain, never spun on, never shed.
-        return self._publish_overflow(item)
+        return self._publish_overflow(item, code)
 
-    def _publish_overflow(self, item) -> bool:
+    def _publish_overflow(self, item, code: int = RC_MSG) -> bool:
         """Non-blocking must-deliver fallback for a full lane: park the
         item on the overflow queue the next _drain_classify folds FIRST
         (arrival seniority kept). Used for traffic whose producer may
@@ -587,6 +634,7 @@ class BatchCoordinator:
             return True
         with self._overflow_lock:
             self._overflow_q.append(item)
+            self._overflow_codes.append(code)
         self.counters.incr("ingress_overflow_msgs")
         if not self._wake.is_set():
             self._wake.set()
@@ -609,7 +657,8 @@ class BatchCoordinator:
         off every client lock. ``names`` must not be mutated after the
         call. Blocks (gate-paced) when the lane is full — the bulk
         producer is the natural place to absorb backpressure."""
-        self._publish_bulk((self._R_CMDS, names, cmd))
+        code = RC_CMDS_LOW if cmd.priority == "low" else RC_CMDS
+        self._publish_bulk((self._R_CMDS, names, cmd), code)
 
     def wal_notify(self, uid: str, evt) -> None:
         """Log-event entry point for WAL / segment-writer notify
@@ -684,9 +733,9 @@ class BatchCoordinator:
         from_sid)`` triples (unknown group names are dropped at drain,
         as in ``deliver``). Blocks gate-paced when the lane is full."""
         triples = [(to[0], frm, m) for to, m, frm in msgs]
-        self._publish_bulk((self._R_BATCH, triples))
+        self._publish_bulk((self._R_BATCH, triples), RC_BATCH)
 
-    def _publish_bulk(self, item) -> None:
+    def _publish_bulk(self, item, code: int = RC_MSG) -> None:
         """Bulk client publish: keep arrival order (never overtake
         parked overflow work — the overflow queue folds after the lane
         drain) WITHOUT giving up pacing. While overflow is pending,
@@ -705,11 +754,11 @@ class BatchCoordinator:
                 if not self._overflow_q:
                     break
             if self._overflow_q:
-                self._publish_overflow(item)
+                self._publish_overflow(item, code)
                 return
-        if not self._rings.publish(item):
+        if not self._rings.publish(item, code):
             self.counters.incr("ingress_ring_full")
-            self._publish_blocking(item)
+            self._publish_blocking(item, code)
 
     def ingest_batch(self, triples) -> int:
         """Peer-coordinator bulk ingress (the _send_batch fast path):
@@ -725,18 +774,18 @@ class BatchCoordinator:
         if not self._overflow_q:
             # (while older must-deliver work is parked on the overflow
             # queue, a lane publish would overtake it — divert below)
-            if self._rings.publish((self._R_BATCH, triples)):
+            if self._rings.publish((self._R_BATCH, triples), RC_BATCH):
                 return 0
             self.counters.incr("ingress_ring_full")
         must = [t for t in triples if type(t[2]) not in LOSSY_PROTOCOL_TYPES]
         if must:
-            self._publish_overflow((self._R_BATCH, must))
+            self._publish_overflow((self._R_BATCH, must), RC_BATCH)
         if len(must) == len(triples):
             return 0
         # lossy remainder is order-insensitive (sender-retried): it may
         # still ride the lane; shed only what the lane cannot take
         lossy = [t for t in triples if type(t[2]) in LOSSY_PROTOCOL_TYPES]
-        if self._rings.publish((self._R_BATCH, lossy)):
+        if self._rings.publish((self._R_BATCH, lossy), RC_BATCH):
             return 0
         return len(lossy)
 
@@ -1329,7 +1378,14 @@ class BatchCoordinator:
         ``_drain_and_dispatch`` under the lock."""
         _t_in = time.perf_counter_ns()
         buf = self._drain_buf
-        n_items = self._rings.drain(buf)
+        # native classify (docs/INTERNALS.md §18): drain the RC_* code
+        # sidecar alongside the items and let rt_classify partition the
+        # burst with the GIL released; Python keeps the routing half.
+        # Routes around itself while ANY failpoint is armed so nemesis
+        # runs always exercise the Python classification seam.
+        nat = self._nat_classify and not faults.anything_armed()
+        codes = self._drain_codes
+        n_items = self._rings.drain(buf, codes if nat else None)
         if self._overflow_q:
             # overflow items are NEWER than the ring contents drained
             # above (a publish only overflows while the lane is full of
@@ -1341,7 +1397,10 @@ class BatchCoordinator:
             with self._overflow_lock:
                 n_items += len(self._overflow_q)
                 buf.extend(self._overflow_q)
+                if nat:
+                    codes.extend(self._overflow_codes)
                 self._overflow_q.clear()
+                self._overflow_codes.clear()
         cmd_q: Optional[Dict[str, List[Command]]] = None
         routes: Optional[List] = None
         lows: Optional[List] = None
@@ -1349,6 +1408,22 @@ class BatchCoordinator:
             cmd_q = {}
             routes = []
             lows = []
+            if nat and len(codes) == len(buf):
+                t0 = time.perf_counter_ns()
+                part = _native.classify(codes, len(buf))
+                if part is not None:
+                    self._route_classified(buf, part, cmd_q, routes, lows)
+                    self._wave_h["classify_native"].record(
+                        time.perf_counter_ns() - t0)
+                    self.counters.incr("native_classify_batches")
+                    self.counters.incr("native_classify_items", len(buf))
+                    buf.clear()
+                    codes.clear()
+                    self.counters.incr("ingress_ring_msgs", n_items)
+                    self.counters.incr("ingress_ring_drains")
+                    self._ring_gate.open()
+                    return (_t_in, n_items, cmd_q, routes, lows)
+                self.counters.incr("native_fallbacks")
             radd = routes.append
             by = self.by_name
             cq_get = cmd_q.get
@@ -1405,12 +1480,92 @@ class BatchCoordinator:
                         elif name in by:
                             radd(trip)
             buf.clear()
+            if codes:
+                codes.clear()
         if n_items:
             self.counters.incr("ingress_ring_msgs", n_items)
             self.counters.incr("ingress_ring_drains")
             # space was freed on every lane: wake ring-full waiters
             self._ring_gate.open()
         return (_t_in, n_items, cmd_q, routes, lows)
+
+    def _route_classified(self, buf, part, cmd_q, routes, lows) -> None:
+        """Python routing half of the native drain-classify: walk the
+        per-class index partitions ``rt_classify`` returned (arrival
+        order kept within each class) and run each class's straight-
+        line routing loop — no per-item tag dispatch, no priority
+        checks (the producer stamped those into the RC_* code).
+
+        Ordering contract (docs/INTERNALS.md §18): order is preserved
+        WITHIN each class; classes may reorder against each other.
+        That is safe because any producer's causally-ordered commands
+        ride a single class (clients publish R_CMD, bulk drivers
+        R_CMDS, peer forwards R_BATCH) and protocol traffic is
+        reorder-tolerant by the transport contract."""
+        idx, counts = part
+        ilist = idx.tolist()
+        c_msg, c_cmd, c_cmd_low, c_cmds, c_cmds_low, c_batch = counts.tolist()
+        by = self.by_name
+        cq_get = cmd_q.get
+        radd = routes.append
+        ladd = lows.append
+        o = 0
+        for k in ilist[o:o + c_msg]:
+            item = buf[k]
+            name = item[1]
+            if name in by:
+                radd((name, item[2], item[3]))
+        o += c_msg
+        for k in ilist[o:o + c_cmd]:
+            _, name, cmd = buf[k]
+            if name not in by:
+                continue
+            q = cq_get(name)
+            if q is None:
+                cmd_q[name] = [cmd]
+            else:
+                q.append(cmd)
+        o += c_cmd
+        for k in ilist[o:o + c_cmd_low]:
+            _, name, cmd = buf[k]
+            if name in by:
+                ladd((name, cmd))
+        o += c_cmd_low
+        for k in ilist[o:o + c_cmds]:
+            _, names, cmd = buf[k]
+            for name in names:
+                q = cq_get(name)
+                if q is None:
+                    if name not in by:
+                        continue
+                    cmd_q[name] = [cmd]
+                else:
+                    q.append(cmd)
+        o += c_cmds
+        for k in ilist[o:o + c_cmds_low]:
+            _, names, cmd = buf[k]
+            for name in names:
+                if name in by:
+                    ladd((name, cmd))
+        o += c_cmds_low
+        for k in ilist[o:o + c_batch]:
+            for trip in buf[k][1]:
+                name = trip[0]
+                msg = trip[2]
+                if type(msg) is Command:
+                    if msg.priority == "low":
+                        if name in by:
+                            ladd((name, msg))
+                        continue
+                    q = cq_get(name)
+                    if q is None:
+                        if name not in by:
+                            continue
+                        cmd_q[name] = [msg]
+                    else:
+                        q.append(msg)
+                elif name in by:
+                    radd(trip)
 
     def _drain_and_dispatch(
         self, dispatch: bool = True, pre=None
@@ -2157,6 +2312,86 @@ class BatchCoordinator:
     }
     _NROWS = len(C.MBOX_FIELDS) + len(C.MBOX_SCAT_FIELDS)
 
+    # mailbox row-index vectors for the two hot message types, in the
+    # flat value order _pack_hot builds (the native rt_pack_mbox ABI)
+    _REP_ROWS = np.asarray(
+        [_R["msg_type"], _R["sender_slot"], _R["term"], _R["success"],
+         _R["reply_next_idx"], _R["reply_last_idx"],
+         _R["reply_last_term"]],
+        np.int32,
+    )
+    _AER_ROWS = np.asarray(
+        [_R["msg_type"], _R["sender_slot"], _R["term"], _R["prev_idx"],
+         _R["prev_term"], _R["num_entries"], _R["entries_last_term"],
+         _R["leader_commit"]],
+        np.int32,
+    )
+
+    def _pack_hot(self, packed, aer_i, aer_m, aer_s, rep_i, rep_m,
+                  rep_s) -> None:
+        """Columnwise encode of the two hot message types into the
+        packed mailbox. With the native pack path on, each class is one
+        flat int64 value pass + one GIL-released scatter
+        (rt_pack_mbox); otherwise (or while any failpoint is armed, or
+        on a scatter bounds failure) the original per-field numpy
+        column stores run — both produce byte-identical buffers."""
+        if (
+            (rep_i or aer_i)
+            and self._nat_pack
+            and not faults.anything_armed()
+        ):
+            t0 = time.perf_counter_ns()
+            ok = True
+            if rep_i:
+                vals: List[int] = []
+                ext = vals.extend
+                for s, m in zip(rep_s, rep_m):
+                    ext((C.MSG_AER_REPLY, s, m.term,
+                         1 if m.success else 0, m.next_index,
+                         m.last_index, m.last_term))
+                ok = _native.pack_mbox(packed, rep_i, vals, self._REP_ROWS)
+            if ok and aer_i:
+                vals = []
+                ext = vals.extend
+                for s, m in zip(aer_s, aer_m):
+                    ext((C.MSG_AER, s, m.term, m.prev_log_index,
+                         m.prev_log_term, len(m.entries),
+                         m.entries[-1].term if m.entries else 0,
+                         m.leader_commit))
+                ok = _native.pack_mbox(packed, aer_i, vals, self._AER_ROWS)
+            if ok:
+                self._wave_h["pack_native"].record(
+                    time.perf_counter_ns() - t0)
+                self.counters.incr("native_pack_batches")
+                self.counters.incr("native_pack_msgs",
+                                   len(rep_i) + len(aer_i))
+                return
+            # partial native success is harmless: the Python stores
+            # below rewrite the same cells with the same values
+            self.counters.incr("native_fallbacks")
+        R = self._R
+        if rep_i:
+            ii = np.asarray(rep_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER_REPLY
+            packed[R["sender_slot"], ii] = rep_s
+            packed[R["term"], ii] = [m.term for m in rep_m]
+            packed[R["success"], ii] = [1 if m.success else 0 for m in rep_m]
+            packed[R["reply_next_idx"], ii] = [m.next_index for m in rep_m]
+            packed[R["reply_last_idx"], ii] = [m.last_index for m in rep_m]
+            packed[R["reply_last_term"], ii] = [m.last_term for m in rep_m]
+        if aer_i:
+            ii = np.asarray(aer_i, np.int64)
+            packed[R["msg_type"], ii] = C.MSG_AER
+            packed[R["sender_slot"], ii] = aer_s
+            packed[R["term"], ii] = [m.term for m in aer_m]
+            packed[R["prev_idx"], ii] = [m.prev_log_index for m in aer_m]
+            packed[R["prev_term"], ii] = [m.prev_log_term for m in aer_m]
+            packed[R["num_entries"], ii] = [len(m.entries) for m in aer_m]
+            packed[R["entries_last_term"], ii] = [
+                m.entries[-1].term if m.entries else 0 for m in aer_m
+            ]
+            packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
+
     def _fill_scat(self, packed: np.ndarray, app_rows, written) -> None:
         """Write the fused log-tail scatter rows: the newest appended
         run per group and the durable watermarks, pad gid = capacity
@@ -2246,27 +2481,7 @@ class BatchCoordinator:
                 self._encode(g, from_sid, msg, packed, i)
             if g.inbox:
                 self._hot.add(i)  # more queued: stay hot for next step
-        if rep_i:
-            ii = np.asarray(rep_i, np.int64)
-            packed[R["msg_type"], ii] = C.MSG_AER_REPLY
-            packed[R["sender_slot"], ii] = rep_s
-            packed[R["term"], ii] = [m.term for m in rep_m]
-            packed[R["success"], ii] = [1 if m.success else 0 for m in rep_m]
-            packed[R["reply_next_idx"], ii] = [m.next_index for m in rep_m]
-            packed[R["reply_last_idx"], ii] = [m.last_index for m in rep_m]
-            packed[R["reply_last_term"], ii] = [m.last_term for m in rep_m]
-        if aer_i:
-            ii = np.asarray(aer_i, np.int64)
-            packed[R["msg_type"], ii] = C.MSG_AER
-            packed[R["sender_slot"], ii] = aer_s
-            packed[R["term"], ii] = [m.term for m in aer_m]
-            packed[R["prev_idx"], ii] = [m.prev_log_index for m in aer_m]
-            packed[R["prev_term"], ii] = [m.prev_log_term for m in aer_m]
-            packed[R["num_entries"], ii] = [len(m.entries) for m in aer_m]
-            packed[R["entries_last_term"], ii] = [
-                m.entries[-1].term if m.entries else 0 for m in aer_m
-            ]
-            packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
+        self._pack_hot(packed, aer_i, aer_m, aer_s, rep_i, rep_m, rep_s)
         return jnp.asarray(packed), consumed, packed
 
     def _build_mailbox_sub(self, act, app_rows=None, written=None):
@@ -2323,27 +2538,7 @@ class BatchCoordinator:
                 self._encode(g, from_sid, msg, packed, p)
             if g.inbox:
                 self._hot.add(i)  # more queued: stay hot for next step
-        if rep_i:
-            ii = np.asarray(rep_i, np.int64)
-            packed[R["msg_type"], ii] = C.MSG_AER_REPLY
-            packed[R["sender_slot"], ii] = rep_s
-            packed[R["term"], ii] = [m.term for m in rep_m]
-            packed[R["success"], ii] = [1 if m.success else 0 for m in rep_m]
-            packed[R["reply_next_idx"], ii] = [m.next_index for m in rep_m]
-            packed[R["reply_last_idx"], ii] = [m.last_index for m in rep_m]
-            packed[R["reply_last_term"], ii] = [m.last_term for m in rep_m]
-        if aer_i:
-            ii = np.asarray(aer_i, np.int64)
-            packed[R["msg_type"], ii] = C.MSG_AER
-            packed[R["sender_slot"], ii] = aer_s
-            packed[R["term"], ii] = [m.term for m in aer_m]
-            packed[R["prev_idx"], ii] = [m.prev_log_index for m in aer_m]
-            packed[R["prev_term"], ii] = [m.prev_log_term for m in aer_m]
-            packed[R["num_entries"], ii] = [len(m.entries) for m in aer_m]
-            packed[R["entries_last_term"], ii] = [
-                m.entries[-1].term if m.entries else 0 for m in aer_m
-            ]
-            packed[R["leader_commit"], ii] = [m.leader_commit for m in aer_m]
+        self._pack_hot(packed, aer_i, aer_m, aer_s, rep_i, rep_m, rep_s)
         return (
             jnp.asarray(packed),
             jnp.asarray(gidx),
@@ -3080,6 +3275,20 @@ class BatchCoordinator:
                 # must-deliver remainder — never a batch-level drop
                 self.transport.dropped += node.ingest_batch(triples)
             return
+        if self._nat_egress and len(msgs) > 1:
+            # remote batch: seal + length-frame every AER/ack frame for
+            # this destination in ONE GIL-released native call on the
+            # sender path (rt_seal_frames). -1 = native unavailable or
+            # tcp failpoints armed: fall through to per-message send so
+            # fire/mangle semantics apply frame by frame.
+            sb = getattr(self.transport, "send_batch", None)
+            if sb is not None:
+                sent = sb(node_name, msgs)
+                if sent >= 0:
+                    self.counters.incr("native_egress_batches")
+                    self.counters.incr("native_egress_frames", sent)
+                    return
+                self.counters.incr("native_fallbacks")
         for to, msg, frm in msgs:
             self.transport.send(to, msg, from_sid=frm)
 

@@ -88,10 +88,25 @@ def main() -> int:
     # every wave phase and all five commit stages must have fired. The
     # adaptive group-commit flush_wait family must EXIST (a short smoke
     # burst may legitimately never clear the coalescing gate, so its
-    # count may be 0 — presence is the gate).
+    # count may be 0 — presence is the gate). The native hot-loop
+    # phases (docs/INTERNALS.md §18) record only when rt_native.so
+    # loaded — without a compiler they are excluded, with one they must
+    # be NONZERO (the native paths silently never engaging is exactly
+    # the rot this gate exists to catch).
+    from ra_tpu import native as _native
+
+    rt_loaded = _native.entry_points()["classify"]
+    _native_phases = {"classify_native", "pack_native"}
+    if rt_loaded:
+        nc = out.get("native_counters", {})
+        for k in ("native_classify_batches", "native_pack_batches"):
+            if nc.get(k, 0) <= 0:
+                errors.append(f"bench ran with rt_native loaded but {k}=0 "
+                              f"(native path never engaged)")
     required_bench = (
         [rf"ra_wave_bench0_{ph}_seconds_count (\d+)"
-         for ph, _ in obs.WAVE_PHASES]
+         for ph, _ in obs.WAVE_PHASES
+         if rt_loaded or ph not in _native_phases]
         + [rf"ra_commit_bench0_{st}_seconds_count (\d+)"
            for st, _ in obs.COMMIT_STAGES]
         + [r"ra_wal_\w+_fsync_seconds_count (\d+)",
@@ -236,6 +251,18 @@ def main() -> int:
             r"# TYPE ra_group_commit_delay_us gauge",
             r"# TYPE ra_group_commit_waits counter",
             r"# TYPE ra_native_batches counter",
+            # native hot-loop runtime (docs/INTERNALS.md §18): family
+            # presence always; with rt_native loaded the live started
+            # cluster's traffic must have engaged classify and pack
+            # (egress stays 0 in-proc — the TCP seam is not wired here)
+            r"# TYPE ra_native_classify_batches counter",
+            r"# TYPE ra_native_pack_batches counter",
+            r"# TYPE ra_native_egress_batches counter",
+            r"# TYPE ra_native_fallbacks counter",
+        ] + ([
+            r"ra_native_classify_batches\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_native_pack_batches\{[^}]*obs0[^}]*\} (\d+)",
+        ] if rt_loaded else []) + [
             # async command plane (docs/INTERNALS.md §16): the live
             # STARTED cluster above ran its traffic through the
             # lock-free ingress rings, the event-driven step wakeups,

@@ -26,15 +26,24 @@ python -m pytest tests/test_soak.py -q -m soak \
     -p no:cacheprovider -p no:randomly "$@"
 
 echo "== soak: flake gate over $N fresh seeds per backend =="
+# the batch backend alternates the native hot-loop runtime on/off per
+# seed (docs/INTERNALS.md §18): half the grid proves the disk-fault/
+# torn-write failpoints bite through the native fallback seam, half
+# proves the pure-Python plane (the actor backend ignores --native)
 for seed in $(seq 100 $((99 + N))); do
     for backend in per_group_actor tpu_batch; do
         for workload in kv fifo; do
-            echo "-- seed=$seed backend=$backend workload=$workload"
+            native=auto
+            [ "$backend" = tpu_batch ] && [ $((seed % 2)) -eq 1 ] \
+                && native=off
+            echo "-- seed=$seed backend=$backend workload=$workload" \
+                 "native=$native"
             python -m ra_tpu.kv_harness --combined --seed "$seed" \
                 --ops 200 --backend "$backend" --workload "$workload" \
+                --native "$native" \
                 >/tmp/soak_run.log 2>&1 \
                 || { echo "soak FAILED: seed=$seed backend=$backend" \
-                          "workload=$workload"; \
+                          "workload=$workload native=$native"; \
                      tail -60 /tmp/soak_run.log; exit 1; }
         done
     done

@@ -51,8 +51,14 @@ def _assert_soak(res, backend, workload, seed):
 @pytest.mark.parametrize("backend", BACKENDS)
 @pytest.mark.parametrize("seed", SEEDS)
 def test_combined_soak(seed, backend, workload):
+    # native="auto": the batch backend runs the native hot-loop runtime
+    # wherever it loaded (docs/INTERNALS.md §18) — the disk-fault/torn-
+    # write storms this grid schedules must bite through the armed-
+    # failpoint fallback seam (every native path routes around itself
+    # while ANY failpoint is armed); scripts/soak.sh alternates
+    # --native off across its fresh-seed grid for the A/B
     res = kv_harness.run(seed=seed, n_ops=200, backend=backend,
-                         workload=workload, combined=True)
+                         workload=workload, combined=True, native="auto")
     _assert_soak(res, backend, workload, seed)
 
 
@@ -68,6 +74,14 @@ def test_combined_smoke_batch():
                          combined=True)
     assert res.consistent, res.failures
     assert res.nemesis.get("nemesis_modeflip_injected", 0) > 0
+
+
+def test_combined_smoke_batch_native_off():
+    """The combined regime over the pure-Python command plane — the
+    --native off half of the soak grid's A/B (scripts/soak.sh)."""
+    res = kv_harness.run(seed=3, n_ops=60, backend="tpu_batch",
+                         combined=True, native="off")
+    assert res.consistent, res.failures
 
 
 def test_schedule_replayable_from_seed():
