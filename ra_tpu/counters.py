@@ -268,6 +268,48 @@ NEMESIS_FIELDS: List[FieldSpec] = [
      "faults still armed — the heal-on-every-exit-path guarantee)"),
 ]
 
+# Deterministic simulation plane (ra_tpu/sim, docs/INTERNALS.md §19):
+# one vector per sweep label, accumulated across every schedule the
+# sweep explores — the observability contract the sim lane is gated on
+# (scripts/obs_smoke.py / scripts/sim_sweep.sh).
+SIM_FIELDS: List[FieldSpec] = [
+    ("sim_schedules_run", "counter", "simulation schedules executed"),
+    ("sim_schedules_failed", "counter",
+     "schedules whose oracle found a violation"),
+    ("sim_steps_executed", "counter",
+     "virtual-time events executed across all schedules"),
+    ("sim_msgs_delivered", "counter", "network messages delivered"),
+    ("sim_msgs_dropped", "counter",
+     "messages dropped (blocked pairs + schedule drops)"),
+    ("sim_msgs_duplicated", "counter", "duplicate deliveries injected"),
+    ("sim_msgs_delayed", "counter", "deliveries given a schedule delay"),
+    ("sim_shrink_iterations", "counter",
+     "delta-debugging replays run while minimizing failures"),
+    ("sim_minimized_ops", "counter",
+     "ops in the last minimized repro schedule"),
+    ("sim_virtual_ms", "counter", "virtual milliseconds simulated"),
+]
+
+# Session/lock-service machine (ra_tpu/models/session.py). The vector
+# is owned by whoever constructs the machine (harness, sim world,
+# smoke gate) — replicas constructed WITHOUT one stay silent, so a
+# 3-replica fold does not triple-count.
+SESSION_FIELDS: List[FieldSpec] = [
+    ("session_opens", "counter", "sessions opened"),
+    ("session_renews", "counter", "lease renewals"),
+    ("session_closes", "counter", "clean session closes"),
+    ("session_expiries_ttl", "counter",
+     "sessions expired by TTL lapse (machine timer)"),
+    ("session_expiries_down", "counter",
+     "sessions expired by monitor DOWN"),
+    ("session_lock_acquires", "counter", "lock grants (immediate)"),
+    ("session_lock_waits", "counter", "lock requests queued behind a holder"),
+    ("session_lock_releases", "counter", "explicit lock releases"),
+    ("session_lock_steals", "counter", "locks stolen from a live holder"),
+    ("session_lock_handoffs", "counter",
+     "locks handed to a queued waiter after release/expiry"),
+]
+
 SEGMENT_WRITER_FIELDS: List[FieldSpec] = [
     ("mem_tables_flushed", "counter", "memtable flush jobs"),
     ("entries_flushed", "counter", "entries flushed to segments"),

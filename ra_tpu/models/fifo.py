@@ -51,6 +51,15 @@ class FifoState:
         return st
 
 
+# Test-only failpoint: re-introduces the reversed-requeue bug (a
+# multi-message consumer down redelivers highest msg_id first) that the
+# comment in the down/cancel branch below guards against. Exists solely
+# so the simulation plane can demonstrate end-to-end that its schedule
+# explorer finds the violation and the shrinker minimizes the repro
+# (tests/test_sim.py, docs/INTERNALS.md §19). Never set outside tests.
+SIM_BUG_REVERSED_REQUEUE = False
+
+
 class FifoMachine(Machine):
     def init(self, config) -> FifoState:
         return FifoState()
@@ -126,7 +135,9 @@ class FifoMachine(Machine):
                 # reverses, so walk the ids highest-first — the lowest
                 # msg_id must end up at the head or a multi-message down
                 # (prefetch > 1) redelivers out of FIFO order
-                for msg_id, msg in sorted(inflight.items(), reverse=True):
+                for msg_id, msg in sorted(
+                    inflight.items(), reverse=not SIM_BUG_REVERSED_REQUEUE
+                ):
                     st.queue.appendleft((msg_id, msg))
                 self._service(st, effects)
             return st, ("ok", None), effects

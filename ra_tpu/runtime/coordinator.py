@@ -142,9 +142,14 @@ class GroupHost:
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
         "specials", "last_ok_sent", "fresh_tail", "match_hint", "lat",
+        "_clock",
     )
 
-    def __init__(self, gid, name, cluster_name, members, self_slot, log, machine):
+    def __init__(self, gid, name, cluster_name, members, self_slot, log, machine,
+                 clock=None):
+        from ra_tpu.runtime.clock import WALL
+
+        self._clock = clock or WALL
         self.gid = gid
         self.name = name
         self.cluster_name = cluster_name
@@ -216,7 +221,7 @@ class GroupHost:
         # ticks" is a reliable leaderless signal — the detector uses it
         # to retry elections after partition heals (a stalled pre-vote
         # or a deposed-leader cluster would otherwise wedge forever)
-        self.last_contact = time.monotonic()
+        self.last_contact = self._clock.monotonic()
         # buffered low-priority commands, drained in bounded slices
         # after normal traffic (reference: ra_ets_queue lane,
         # src/ra_server_proc.erl:507-530)
@@ -290,7 +295,16 @@ class BatchCoordinator:
         ingress_ring_slots: int = 8192,
         egress_async: bool = True,
         native: str = "auto",
+        clock=None,
     ):
+        from ra_tpu.runtime.clock import WALL
+
+        # behavioral clock seam (docs/INTERNALS.md §19): election/resync
+        # windows, contact stamps and the tick cadence read this clock;
+        # the monotonic_ns() latency-histogram stamps below intentionally
+        # stay on the wall clock (they measure real host time and the
+        # simulation plane never drives this backend)
+        self.clock = clock or WALL
         self.name = node_name
         self.capacity = capacity
         self.P = num_peers
@@ -878,6 +892,7 @@ class BatchCoordinator:
             g = GroupHost(
                 gid, name, cluster_name, members, members.index(sid),
                 log or MemoryLog(auto_written=True), machine,
+                clock=self.clock,
             )
             # restart safety: reload the durable term/vote so this
             # member cannot re-vote in a term it already voted in
@@ -1643,7 +1658,7 @@ class BatchCoordinator:
                     g.low_q.append(cmd)
                     low_dirty.add(g.gid)
         if routes:
-            now_mono = time.monotonic()
+            now_mono = self.clock.monotonic()
             for name, from_sid, msg in routes:
                 g = by_get(name)
                 if g is not None:
@@ -1933,7 +1948,7 @@ class BatchCoordinator:
     def _route_one(self, g: GroupHost, from_sid, msg, rare, appended,
                    written, aer_dirty, route_out, now_mono=None):
         if now_mono is None:
-            now_mono = time.monotonic()
+            now_mono = self.clock.monotonic()
         if type(msg) is FromPeer:
             from_sid, msg = msg.peer, msg.msg
         t = type(msg)
@@ -2649,7 +2664,7 @@ class BatchCoordinator:
                             # (Raft §3.4): the granter must give its
                             # candidate a full round before campaigning
                             # itself, or dueling candidacies ping-pong
-                            g.last_contact = time.monotonic()
+                            g.last_contact = self.clock.monotonic()
                         queue_send(
                             from_sid,
                             RequestVoteResult(term_l[p], bool(succ_l[p])),
@@ -2657,7 +2672,7 @@ class BatchCoordinator:
                         )
                     elif t is PreVoteRpc:
                         if succ_l[p]:
-                            g.last_contact = time.monotonic()
+                            g.last_contact = self.clock.monotonic()
                         queue_send(
                             from_sid,
                             PreVoteResult(term_l[p], msg.token, bool(succ_l[p])),
@@ -2692,7 +2707,7 @@ class BatchCoordinator:
             ca_l = eg["commit_advanced_to"][ti].tolist()
             nh2_l = needs_host[ti].tolist()
             ag_l = eg["agreed_idx"][ti].tolist()
-            now_roles = time.monotonic()
+            now_roles = self.clock.monotonic()
             for p, pos in enumerate(touched):
                 i = pos if act is None else int(act[pos])
                 g = groups[i]
@@ -2868,7 +2883,7 @@ class BatchCoordinator:
         if wi >= last_entry:
             ack = min(wi, last_entry)
             prev = g.last_ok_sent
-            now = time.monotonic()
+            now = self.clock.monotonic()
             if (
                 prev is not None
                 and prev[0] == from_sid
@@ -3313,7 +3328,7 @@ class BatchCoordinator:
 
     def _send_aers(self, aer_dirty) -> None:
         outbound: Dict[str, List] = {}
-        now = time.monotonic()
+        now = self.clock.monotonic()
         for gid in aer_dirty:
             g = self.groups[gid]
             if g is None:
@@ -3470,7 +3485,7 @@ class BatchCoordinator:
             self._pending_roles.append((g.gid, C.R_PRE_VOTE))
             g.role = C.R_PRE_VOTE
             g.pre_vote_token += 1
-            g.last_contact = time.monotonic()  # election-retry window restarts
+            g.last_contact = self.clock.monotonic()  # election-retry window restarts
             self._hot.add(g.gid)  # force steps so the election progresses
             if len(g.members) == 1:
                 return  # the next device steps self-elect
@@ -3501,7 +3516,7 @@ class BatchCoordinator:
             g.role = C.R_CANDIDATE
             g.term += 1
             g.leader_slot = -1
-            g.last_contact = time.monotonic()
+            g.last_contact = self.clock.monotonic()
             if self.meta is not None:
                 # term AND self-vote must be durable before any vote
                 # request leaves this node (restart double-vote safety)
@@ -3562,7 +3577,7 @@ class BatchCoordinator:
             self.counters.incr("lane_recoveries")
             self._hot.add(g.gid)
             if g.role == C.R_LEADER:
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 for s, m in enumerate(g.members):
                     if (
                         m is not None and s != g.self_slot
@@ -3580,7 +3595,7 @@ class BatchCoordinator:
             return
         if isinstance(msg, tuple) and msg and msg[0] == "resync":
             if g.role == C.R_LEADER:
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 for s in msg[1]:
                     if s < len(g.commit_sent):
                         # -1 sentinel: the probe must fire even at
@@ -3605,7 +3620,7 @@ class BatchCoordinator:
             # that never acknowledged the term would be meaningless).
             if from_sid is not None:
                 if msg.term >= g.term:
-                    g.last_contact = time.monotonic()
+                    g.last_contact = self.clock.monotonic()
                     if msg.term > g.term or g.role != C.R_FOLLOWER:
                         self._adopt_term(g, msg.term, leader_sid=from_sid)
                     elif g.leader_slot < 0:
@@ -3770,7 +3785,7 @@ class BatchCoordinator:
         if self._voter_count(g) <= 1:
             self._reply(fut, ("ok", fn(g.machine_state), me))
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
         fresh = []
         for q in g.pending_queries:
             if now - q["t"] < 10.0:
@@ -3811,7 +3826,7 @@ class BatchCoordinator:
         g.term = max(g.term, term)
         was_leader = g.role == C.R_LEADER
         g.role = C.R_FOLLOWER
-        g.last_contact = time.monotonic()
+        g.last_contact = self.clock.monotonic()
         g.leader_slot = g.slot_of(leader_sid) if leader_sid is not None else -1
         if was_leader:
             # deposed outside the device mailbox: same redirect contract
@@ -3871,7 +3886,7 @@ class BatchCoordinator:
             li, lt = g.log.last_index_term()
             send_one(InstallSnapshotResult(g.term, li, lt))
             return
-        g.last_contact = time.monotonic()
+        g.last_contact = self.clock.monotonic()
         if msg.chunk_phase == CHUNK_INIT:
             # INIT always starts a fresh accumulator — a retried transfer
             # at the same index must not append onto stale chunks. Chunk
@@ -4047,10 +4062,10 @@ class BatchCoordinator:
         # command-lane watchdog state per gid:
         # (applied_seen, oldest_pending_idx, since, strikes)
         lane_watch: Dict[int, Tuple[int, int, float, int]] = {}
-        last_tick = time.monotonic()
+        last_tick = self.clock.monotonic()
         while self.running:
             try:
-                now0 = time.monotonic()
+                now0 = self.clock.monotonic()
                 if now0 - last_tick >= self.tick_interval_s:
                     last_tick = now0
                     self._lane_watchdog(lane_watch, now0)
@@ -4077,7 +4092,7 @@ class BatchCoordinator:
                         "ingress_ring_lanes", self._rings.lanes()
                     )
                     self._health_scan(now0)
-                    ms = int(time.time() * 1000)
+                    ms = int(self.clock.time() * 1000)
                     for i in range(self.n_groups):
                         g = self.groups[i]
                         if g is None:
@@ -4134,7 +4149,7 @@ class BatchCoordinator:
                 # window >> the 2-tick probe cadence: device pre-vote
                 # grants have no leader-stickiness, so a trigger-happy
                 # sweep could dethrone a healthy but loaded leader
-                now = time.monotonic()
+                now = self.clock.monotonic()
                 contact_window = max(
                     5 * self.tick_interval_s, 6 * self.election_timeout_s
                 )
@@ -4302,7 +4317,7 @@ class BatchCoordinator:
                 # fire time would make the staleness guard in
                 # _handle_rare unable to drop the trigger when the
                 # leader re-establishes contact during the delay
-                armed = time.monotonic()
+                armed = self.clock.monotonic()
                 threading.Timer(
                     delay,
                     lambda gg=g, at=armed: self.deliver(

@@ -72,8 +72,12 @@ class RaNode:
         detector_poll_s: float = 0.1,
         scheduler_workers: int = 4,
         tcp: bool = False,
+        clock=None,
     ):
         self.name = name
+        from ra_tpu.runtime.clock import WALL
+
+        self.clock = clock or WALL
         self.config = config or SystemConfig(name="default")
         self.dir = os.path.join(self.config.data_dir, name)
         os.makedirs(self.dir, exist_ok=True)
@@ -87,7 +91,7 @@ class RaNode:
         # compaction must never occupy a raft worker and starve
         # mailbox drains (heartbeats, elections)
         self.bg_scheduler = Scheduler(workers=2)
-        self.timers = TimerService()
+        self.timers = TimerService(clock=self.clock)
         self.bg = ThreadPoolExecutor(max_workers=2, thread_name_prefix=f"ra-bg-{name}")
         self.monitors = Monitors()
         self._bg_actors: Dict[str, Any] = {}  # per-server ordered bg queues
@@ -720,7 +724,7 @@ class RaNode:
         )
 
     def _detect_loop(self) -> None:
-        import time as _t
+        _t = self.clock
 
         last_health = 0.0
         while self.running:

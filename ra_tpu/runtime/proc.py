@@ -111,7 +111,9 @@ class SnapshotSender:
 
     def _await_ack(self, chunk_no: int, timeout: float) -> str:
         """-> "ack" | "result" (terminal reply: stop streaming) |
-        "timeout"."""
+        "timeout". Wall clock on purpose (clock-seam audit, INTERNALS
+        §19): this blocks a real Condition on a real sender thread —
+        paths the simulation plane never runs."""
         deadline = time.monotonic() + timeout
         with self.acks:
             while True:
@@ -202,6 +204,11 @@ class ServerProc:
         self.server = server
         self.transport = node.transport
         self.timers = node.timers
+        self.clock = getattr(node, "clock", None)
+        if self.clock is None:
+            from ra_tpu.runtime.clock import WALL
+
+            self.clock = WALL
         self.name = server.id[0]
         self.actor = node.scheduler.actor(self.name, self._on_batch)
         self.tick_interval_s = node.tick_interval_s
@@ -216,7 +223,7 @@ class ServerProc:
         self._election_ref: Optional[int] = None
         self._condition_ref: Optional[int] = None
         self._tick_ref: Optional[int] = None
-        self.last_leader_contact: float = time.monotonic()
+        self.last_leader_contact: float = self.clock.monotonic()
         # commit-rate gauge (reference: ra_li leaky integrator driving the
         # commit_rate overview gauge)
         from ra_tpu.li import LeakyIntegrator
@@ -224,7 +231,7 @@ class ServerProc:
         self._commit_rate = LeakyIntegrator()
         # seed with the recovered commit index so the first sample
         # measures new traffic, not the entire recovered history
-        self._last_commit_sample = (time.monotonic(), server.commit_index)
+        self._last_commit_sample = (self.clock.monotonic(), server.commit_index)
         self._senders: Dict[ServerId, SnapshotSender] = {}
         self._snap_retry: Dict[ServerId, Any] = {}  # peer -> retry timer ref
         self._machine_timers: Dict[Any, int] = {}
@@ -354,7 +361,7 @@ class ServerProc:
         can cancel the armed timer and leave the cluster leaderless."""
         if not isinstance(msg.msg, (AppendEntriesRpc, InstallSnapshotRpc, HeartbeatRpc)):
             return
-        self.last_leader_contact = time.monotonic()
+        self.last_leader_contact = self.clock.monotonic()
         if (
             self.server.role in (FOLLOWER, AWAIT_CONDITION, RECEIVE_SNAPSHOT)
             and self._election_ref is not None
@@ -507,12 +514,12 @@ class ServerProc:
     def _on_tick(self) -> None:
         if not self.running:
             return
-        self.enqueue(Tick(now_ms=int(time.time() * 1000)))
+        self.enqueue(Tick(now_ms=int(self.clock.time() * 1000)))
         self._set_tick_timer()
 
     def _sample_commit_rate(self) -> None:
         """Runs on the actor thread (single-owner server state)."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         prev_t, prev_ci = self._last_commit_sample
         ci = self.server.commit_index
         rate = self._commit_rate.sample(max(0, ci - prev_ci), now - prev_t)

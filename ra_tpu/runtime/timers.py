@@ -13,8 +13,9 @@ import itertools
 import random
 import logging
 import threading
-import time
 from typing import Any, Callable, Dict, Optional
+
+from ra_tpu.runtime.clock import WALL
 
 
 logger = logging.getLogger("ra_tpu")
@@ -22,7 +23,8 @@ logger = logging.getLogger("ra_tpu")
 
 
 class TimerService:
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        self._clock = clock or WALL
         self._heap: list = []
         self._cancelled: set = set()
         self._live: set = set()
@@ -35,7 +37,7 @@ class TimerService:
     def after(self, delay_s: float, cb: Callable[[], None]) -> int:
         ref = next(self._refs)
         with self._cv:
-            heapq.heappush(self._heap, (time.monotonic() + delay_s, ref, cb))
+            heapq.heappush(self._heap, (self._clock.monotonic() + delay_s, ref, cb))
             self._live.add(ref)
             self._cv.notify()
         return ref
@@ -57,7 +59,7 @@ class TimerService:
                 if self._closed:
                     return
                 deadline, ref, cb = self._heap[0]
-                now = time.monotonic()
+                now = self._clock.monotonic()
                 if deadline > now:
                     self._cv.wait(timeout=min(deadline - now, 0.5))
                     continue
@@ -81,6 +83,7 @@ class TimerService:
         self._thread.join(timeout=2)
 
 
-def randomized_election_timeout(base_s: float) -> float:
-    """Randomized timeout so colliding candidates de-synchronize."""
-    return base_s * (1.0 + random.random())
+def randomized_election_timeout(base_s: float, rng: Optional[random.Random] = None) -> float:
+    """Randomized timeout so colliding candidates de-synchronize. An
+    explicit ``rng`` makes the draw seed-deterministic (sim plane)."""
+    return base_s * (1.0 + (rng or random).random())

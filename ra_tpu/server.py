@@ -230,6 +230,10 @@ class ServerConfig:
     # member supports it; "quorum": once a quorum does (reference:
     # src/ra_server.erl:223-233)
     machine_upgrade_strategy: str = "all"
+    # injectable clock (ra_tpu/runtime/clock.py): every behavioral time
+    # read (check-quorum windows, peer-contact stamps) goes through it;
+    # None = the real wall clock. The sim plane injects a VirtualClock.
+    clock: Optional[Any] = None
 
 
 class Server:
@@ -240,6 +244,9 @@ class Server:
         self.id: ServerId = cfg.server_id
         self.log = log
         self.meta = meta
+        from ra_tpu.runtime.clock import WALL
+
+        self._clock = cfg.clock or WALL
         self.machine = cfg.machine
         self.role: str = FOLLOWER
         self.leader_id: Optional[ServerId] = None
@@ -608,7 +615,7 @@ class Server:
     def _become_leader(self, effects: EffectList) -> None:
         self.leader_id = self.id
         last_idx, _ = self.log.last_index_term()
-        now = time.monotonic()
+        now = self._clock.monotonic()
         for sid, p in self.cluster.items():
             if sid != self.id:
                 p.next_index = last_idx + 1
@@ -651,7 +658,7 @@ class Server:
         effects: EffectList = []
         if from_peer is not None and from_peer in self.cluster:
             # ANY inbound message from a member is check-quorum contact
-            self._peer_contact[from_peer] = time.monotonic()
+            self._peer_contact[from_peer] = self._clock.monotonic()
         if isinstance(msg, Command):
             self._c("commands")
             self._append_leader(msg, effects)
@@ -1109,7 +1116,7 @@ class Server:
         win = self.cfg.check_quorum_window_s
         if win <= 0:
             return False
-        now = time.monotonic()
+        now = self._clock.monotonic()
         live = 1 if self.is_voter_self() else 0
         for sid, p in self.cluster.items():
             if sid == self.id or not p.is_voter():

@@ -1,0 +1,52 @@
+"""Virtual clock: integer-millisecond simulated time.
+
+Drop-in for ``ra_tpu.runtime.clock.WallClock`` behind the clock seam
+(``ServerConfig.clock``, ``TimerService(clock=...)``): ``monotonic()``
+and ``time()`` read simulated time, and ``sleep()`` REFUSES — in a
+deterministic simulation nothing may block a real thread; waiting is
+expressed by scheduling an event (``SimScheduler.after_ms``). Any
+``sleep`` reaching the virtual clock is a bug in the caller: code that
+still needs a thread does not belong under the sim plane.
+
+Time is integer milliseconds internally so two runs can never diverge
+through float accumulation; ``monotonic()``/``time()`` convert at the
+edge. ``time()`` is offset by a fixed epoch so code that formats wall
+timestamps (Tick.now_ms consumers, log lines) sees plausible values —
+the epoch is a constant, never ``time.time()``, or determinism dies.
+"""
+
+from __future__ import annotations
+
+# fixed, arbitrary "wall" base: 2020-09-13T12:26:40Z
+SIM_EPOCH_S = 1_600_000_000
+
+
+class VirtualClock:
+    __slots__ = ("now_ms",)
+
+    def __init__(self) -> None:
+        self.now_ms: int = 0
+
+    # -- WallClock interface ------------------------------------------------
+
+    def monotonic(self) -> float:
+        return self.now_ms / 1000.0
+
+    def monotonic_ns(self) -> int:
+        return self.now_ms * 1_000_000
+
+    def time(self) -> float:
+        return SIM_EPOCH_S + self.now_ms / 1000.0
+
+    def sleep(self, seconds: float) -> None:
+        raise RuntimeError(
+            "sleep() on the virtual clock: simulated code must schedule "
+            "an event (SimScheduler.after_ms), never block a thread"
+        )
+
+    # -- simulation driver ----------------------------------------------------
+
+    def advance_to(self, t_ms: int) -> None:
+        if t_ms < self.now_ms:
+            raise ValueError(f"time moved backwards: {t_ms} < {self.now_ms}")
+        self.now_ms = t_ms

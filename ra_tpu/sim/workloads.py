@@ -1,0 +1,204 @@
+"""Sim workloads: op generators, machine factories, and oracles.
+
+One entry per workload kind ("kv" | "fifo" | "session"). Each supplies:
+
+- ``generate_ops(schedule)`` — the seeded external timeline (client
+  commands, client downs, nemesis steps), a pure function of the
+  schedule so a run replays from the schedule alone;
+- a machine factory (small snapshot intervals so release cursors, log
+  truncation, and therefore real snapshot transfers happen inside a
+  ten-virtual-second run);
+- a per-apply invariant — the workload's safety oracle, checked on
+  EVERY replica at EVERY applied index by the world's recording
+  wrapper. Invariants are written against what correct code can
+  legitimately do, not against incidental behaviour:
+
+  * fifo: a consumer-down requeue batch must redeliver in ascending
+    msg_id order — counting both same-apply deliveries to other ready
+    consumers and what stays parked at the queue head (the
+    reversed-requeue failpoint violates exactly this, and a
+    multi-consumer interleaving of CORRECT downs does not);
+  * session: lock safety — every lock owner is a live session, fencing
+    tokens per key strictly increase, and a session leaves the state
+    only via its own close or an attributable expiry (a ``down``
+    builtin or a matching-generation ``timeout``);
+  * kv: no per-apply invariant; the cross-replica digest check in the
+    world (state-machine safety: equal states at equal applied index)
+    carries it.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ra_tpu.effects import SendMsg
+from ra_tpu.models.fifo import FifoMachine
+from ra_tpu.models.kv import KvMachine
+from ra_tpu.models.session import SessionMachine
+
+WORKLOADS = ("kv", "fifo", "session")
+
+_KV_KEYS = 8
+_FIFO_CONSUMERS = ("c0", "c1", "c2")
+_SESSIONS = ("s0", "s1", "s2", "s3")
+_LOCK_KEYS = ("lk0", "lk1", "lk2")
+
+
+def make_machine(workload: str, ctr=None):
+    """Machine factory for one replica. ``ctr`` (SESSION_FIELDS) goes to
+    exactly one replica's machine — apply runs on every replica, so a
+    shared vector would multiply every count by the cluster size."""
+    if workload == "kv":
+        return KvMachine(snapshot_interval=24)
+    if workload == "fifo":
+        return FifoMachine()
+    if workload == "session":
+        return SessionMachine(ctr=ctr)
+    raise ValueError(f"unknown workload {workload!r}")
+
+
+# -- op generation -------------------------------------------------------------
+
+
+def generate_ops(sched) -> List[Tuple[int, Tuple[Any, ...]]]:
+    rng = random.Random((sched.seed << 4) ^ 0x4F5053)  # "OPS"
+    gen = {
+        "kv": _gen_kv,
+        "fifo": _gen_fifo,
+        "session": _gen_session,
+    }[sched.workload]
+    ops: List[Tuple[int, Tuple[Any, ...]]] = []
+    # ops spread across the horizon with jittered gaps; the settle
+    # window after the horizon is op-free so the cluster can quiesce
+    t = 0
+    gap = max(2, (2 * sched.horizon_ms) // max(1, sched.n_ops))
+    for i in range(sched.n_ops):
+        t += 1 + rng.randrange(gap)
+        if t >= sched.horizon_ms:
+            break
+        ops.append((t, gen(rng, i)))
+    if sched.nemesis:
+        k = 0
+        for t in range(300, sched.horizon_ms, 400):
+            ops.append((t, ("nem", k)))
+            k += 1
+    ops.sort(key=lambda p: p[0])
+    return ops
+
+
+def _gen_kv(rng: random.Random, i: int) -> Tuple[Any, ...]:
+    r = rng.random()
+    key = f"k{rng.randrange(_KV_KEYS)}"
+    if r < 0.75:
+        return ("cmd", ("put", key, i))
+    if r < 0.9:
+        return ("cmd", ("delete", key))
+    return ("cmd", ("keys",))
+
+
+def _gen_fifo(rng: random.Random, i: int) -> Tuple[Any, ...]:
+    r = rng.random()
+    cid = rng.choice(_FIFO_CONSUMERS)
+    if r < 0.5:
+        return ("cmd", ("enqueue", f"m{i}"))
+    if r < 0.72:
+        return ("cmd", ("checkout", cid, 1 + rng.randrange(3)))
+    if r < 0.9:
+        # settle a plausible id; settling a non-inflight id is a no-op
+        return ("cmd", ("settle", cid, 1 + rng.randrange(max(i, 1))))
+    return ("down", cid)
+
+
+def _gen_session(rng: random.Random, i: int) -> Tuple[Any, ...]:
+    r = rng.random()
+    sid = rng.choice(_SESSIONS)
+    key = rng.choice(_LOCK_KEYS)
+    if r < 0.25:
+        return ("cmd", ("session_open", sid, 200 + rng.randrange(1200)))
+    if r < 0.4:
+        return ("cmd", ("session_renew", sid))
+    if r < 0.48:
+        return ("cmd", ("session_close", sid))
+    if r < 0.68:
+        return ("cmd", ("lock_acquire", sid, key))
+    if r < 0.78:
+        return ("cmd", ("lock_acquire", sid, key, "steal"))
+    if r < 0.9:
+        return ("cmd", ("lock_release", sid, key))
+    return ("down", sid)
+
+
+# -- per-apply invariants (the workload oracles) --------------------------------
+
+
+def invariant_for(workload: str) -> Optional[Callable]:
+    return {
+        "kv": None,
+        "fifo": _fifo_invariant,
+        "session": _session_invariant,
+    }[workload]
+
+
+def _fifo_invariant(cmd, pre, post, effs,
+                    tracker: Dict[str, Any]) -> Optional[str]:
+    if isinstance(cmd, tuple) and cmd and cmd[0] in ("down", "cancel"):
+        cid = cmd[1]
+        batch = sorted((pre.consumers.get(cid) or {}).keys())
+        if len(batch) >= 2:
+            # the requeued batch lands at the queue FRONT, and _service
+            # may hand part (or all) of it to other ready consumers
+            # within the same apply — walking the queue front in order.
+            # So the observable redelivery order is: batch members among
+            # this apply's delivery effects (in effect order), then the
+            # batch members still parked at the queue head. Correct code
+            # makes that concatenation exactly the ascending batch; the
+            # reversed-requeue failpoint cannot.
+            batch_set = set(batch)
+            delivered = [
+                e.msg[1] for e in effs
+                if isinstance(e, SendMsg) and e.msg
+                and e.msg[0] == "delivery" and e.msg[1] in batch_set
+            ]
+            head = []
+            for mid, _m in post.queue:
+                if mid not in batch_set:
+                    break
+                head.append(mid)
+            if delivered + head != batch:
+                return (
+                    f"requeue order violated: consumer {cid} went down "
+                    f"holding {batch}, redelivery order {delivered + head}"
+                )
+    return None
+
+
+def _session_invariant(cmd, pre, post, effs,
+                       tracker: Dict[str, Any]) -> Optional[str]:
+    # 1. lock safety: every holder is a live session
+    for key, (owner, token) in post.locks.items():
+        if owner not in post.sessions:
+            return f"lock {key} held by dead session {owner} (token {token})"
+    # 2. fencing tokens strictly increase per key across grants
+    last: Dict[Any, int] = tracker.setdefault("tokens", {})
+    for key, (owner, token) in post.locks.items():
+        prev = last.get(key)
+        if prev is not None and token < prev:
+            return f"fencing token regressed on {key}: {prev} -> {token}"
+        last[key] = max(token, prev or 0)
+    # 3. every expiry attributable: sessions leave only via their own
+    #    close, a down builtin, or a matching-generation ttl timeout
+    gone = set(pre.sessions) - set(post.sessions)
+    if gone:
+        op = cmd[0] if isinstance(cmd, tuple) and cmd else None
+        if op not in ("session_close", "down", "timeout"):
+            return f"sessions {sorted(gone)} vanished on {op!r} command"
+        if op == "timeout":
+            name = cmd[1]
+            sid, gen = name[1], name[2]
+            if gone != {sid} or pre.sessions[sid].gen != gen:
+                return (
+                    f"timeout {name!r} expired {sorted(gone)} "
+                    f"(gen mismatch or wrong session)"
+                )
+    return None

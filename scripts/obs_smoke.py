@@ -237,6 +237,23 @@ def main() -> int:
         if _nem_blocked:
             errors.append("nemesis heal left one-sided blocks armed")
 
+        # deterministic simulation plane (docs/INTERNALS.md §19): run
+        # one short faulted session schedule in-process so the sim_*
+        # counters AND the session/lock machine's session_* counters
+        # are present and nonzero in the scrape — the sweep lane
+        # (scripts/sim_sweep.sh) asserts against these same families
+        from ra_tpu.sim import Schedule as _SimSchedule
+        from ra_tpu.sim import run_schedule as _run_sim
+
+        _sim_res = _run_sim(_SimSchedule(
+            seed=1, workload="session",
+            drop_p=0.05, dup_p=0.05, delay_p=0.2,
+        ))
+        if not _sim_res.ok:
+            errors.append(
+                f"obs_smoke sim schedule failed: {_sim_res.violations[:1]}"
+            )
+
         text = api.prometheus_metrics()
         required_live = required_bench + [
             r"# TYPE ra_commit_rate gauge",
@@ -298,6 +315,32 @@ def main() -> int:
             r"# TYPE ra_nemesis_overload_injected counter",
             r"# TYPE ra_nemesis_modeflip_injected counter",
             r"# TYPE ra_nemesis_heals_forced counter",
+            # deterministic simulation plane (docs/INTERNALS.md §19):
+            # the in-process schedule above must have run, stepped
+            # virtual time, and exercised every network fault band
+            r"ra_sim_schedules_run\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_steps_executed\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_virtual_ms\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_msgs_delivered\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_msgs_dropped\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_msgs_duplicated\{[^}]*plane[^}]*\} (\d+)",
+            r"ra_sim_msgs_delayed\{[^}]*plane[^}]*\} (\d+)",
+            r"# TYPE ra_sim_schedules_failed counter",  # 0 = healthy
+            r"# TYPE ra_sim_shrink_iterations counter",
+            r"# TYPE ra_sim_minimized_ops counter",
+            # session/lock machine counters, carried by the sim run:
+            # opens, grants, and at least one TTL lease lapse must have
+            # landed (the sim's whole point is reaching these paths)
+            r"ra_session_opens\{[^}]*sim[^}]*\} (\d+)",
+            r"ra_session_lock_acquires\{[^}]*sim[^}]*\} (\d+)",
+            r"ra_session_expiries_ttl\{[^}]*sim[^}]*\} (\d+)",
+            r"# TYPE ra_session_renews counter",
+            r"# TYPE ra_session_closes counter",
+            r"# TYPE ra_session_expiries_down counter",
+            r"# TYPE ra_session_lock_waits counter",
+            r"# TYPE ra_session_lock_releases counter",
+            r"# TYPE ra_session_lock_steals counter",
+            r"# TYPE ra_session_lock_handoffs counter",
         ]
         _check_exposition(text, errors, required_live)
 

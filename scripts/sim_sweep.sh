@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Deterministic-simulation sweep: the fast schedule-exploration lane
+# (docs/INTERNALS.md §19), registered next to scripts/soak.sh and
+# scripts/flake_gate.sh. Where the soak runs a handful of wall-clock
+# fault runs, this lane runs hundreds of virtual-time schedules per CI
+# minute — fresh seeds every run, so coverage accumulates across CI
+# history instead of re-proving the same pinned seeds.
+#
+# Phase 1 is the sim-marked pytest lane over a fresh seed base. Phase 2
+# is the explorer straight through its CLI: kv + fifo + session, network
+# faults and nemesis storms on. Any failing schedule is auto-shrunk and
+# printed as a standalone repro; re-run one with:
+#
+#   python - <<'EOF'
+#   from ra_tpu.sim import loads, run_schedule
+#   print(run_schedule(loads(open("repro.txt").read())).violations)
+#   EOF
+#
+# Usage: scripts/sim_sweep.sh [N_SEEDS_PER_WORKLOAD] [extra pytest args]
+# Budget: <= 60s of CI (N=40 -> 120 schedules, well under).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+# fresh seeds per CI run, printed so any failure is reproducible
+SIM_SEED_BASE="${SIM_SEED_BASE:-$(( $(date +%s) % 1000000 ))}"
+export SIM_SEED_BASE
+
+N="${1:-40}"
+shift || true
+
+echo "== sim sweep: pytest lane (SIM_SEED_BASE=$SIM_SEED_BASE) =="
+python -m pytest tests/test_sim.py -q -m sim \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "== sim sweep: explorer, $N fresh seeds x kv/fifo/session =="
+python -m ra_tpu.sim.explorer --seeds "$N" --start "$SIM_SEED_BASE"
+
+echo "sim sweep: PASS (SIM_SEED_BASE=$SIM_SEED_BASE)"

@@ -18,3 +18,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def sim_seed_base():
+    """Seed base for the sim sweep lane: fresh per CI run via
+    SIM_SEED_BASE (scripts/sim_sweep.sh derives one from the date), a
+    pinned default otherwise so plain pytest stays reproducible."""
+    return int(os.environ.get("SIM_SEED_BASE", "1000"))

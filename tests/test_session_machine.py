@@ -1,0 +1,238 @@
+"""SessionMachine property tests against an in-process oracle.
+
+The oracle asserts the lock-safety contract, not the mechanism:
+
+- never two live holders — every lock's owner is an OPEN session, at
+  every step on every replica;
+- fencing tokens per key strictly increase across grants, so a deposed
+  or paused ex-holder can always be fenced out downstream;
+- exactly-once, attributable expiry — a session leaves the state only
+  via its own close, a monitor ``down``, or a ``timeout`` whose
+  generation matches the live lease (stale timers from before a renewal
+  must be provable no-ops), and each expiry notifies the session exactly
+  once.
+
+As in test_fifo_machine.py, the same command sequence folds on three
+independent machine instances which must stay byte-identical in state,
+replies, and effects at every step — then deterministic regressions pin
+the rare paths: stale-generation timeouts, steal fencing, waiter
+handoff past dead sessions, and leader state_enter re-arming.
+"""
+
+import random
+from collections import deque
+
+import pytest
+
+from ra_tpu.effects import Demonitor, Monitor, ReleaseCursor, SendMsg, Timer
+from ra_tpu.models.session import SessionMachine
+
+
+def _meta(i):
+    return {"index": i, "term": 1, "machine_version": 0}
+
+
+def _fingerprint(st):
+    return (
+        tuple((sid, s.ttl_ms, s.gen) for sid, s in st.sessions.items()),
+        tuple(st.locks.items()),
+        tuple(sorted((k, tuple(q)) for k, q in st.waiters.items())),
+        st.next_token,
+    )
+
+
+def _expiry_msgs(effs):
+    return [e.msg for e in effs
+            if isinstance(e, SendMsg) and e.msg and e.msg[0] == "session_expired"]
+
+
+class _Oracle:
+    """Lock-safety + attributable-expiry bookkeeping, independent of the
+    machine's internals."""
+
+    def __init__(self):
+        self.high_token = {}  # key -> highest fencing token ever granted
+
+    def observe(self, cmd, pre, post, reply, effs):
+        # 1. lock safety: every holder is a live session
+        for key, (owner, token) in post.locks.items():
+            assert owner in post.sessions, \
+                f"lock {key} held by dead session {owner}"
+        # 2. fencing tokens strictly increase per key
+        for key, (owner, token) in post.locks.items():
+            prev = self.high_token.get(key)
+            if (key, (owner, token)) not in pre.locks.items():
+                pass
+            held_before = pre.locks.get(key)
+            if held_before != (owner, token):  # a fresh grant happened
+                assert prev is None or token > prev, \
+                    f"fencing token regressed on {key}: {prev} -> {token}"
+                self.high_token[key] = token
+        # 3. exactly-once attributable expiry
+        gone = set(pre.sessions) - set(post.sessions)
+        op = cmd[0] if isinstance(cmd, tuple) and cmd else None
+        expired = _expiry_msgs(effs)
+        if gone:
+            assert op in ("session_close", "down", "timeout"), \
+                f"sessions {sorted(gone)} vanished on {op!r}"
+            assert len(gone) == 1, "one command may expire one session"
+            sid = next(iter(gone))
+            if op == "timeout":
+                name = cmd[1]
+                assert name[1] == sid and pre.sessions[sid].gen == name[2], \
+                    f"timeout {name!r} expired {sid} (stale generation)"
+            if op in ("down", "timeout"):
+                assert [m[1] for m in expired] == [sid], \
+                    f"expiry of {sid} must notify exactly once: {expired}"
+            else:
+                assert not expired, "clean close must not send session_expired"
+        else:
+            assert not expired, f"session_expired without an expiry: {expired}"
+
+
+@pytest.mark.parametrize("seed", [2, 9, 17, 40])
+def test_session_random_ops_safety_and_convergence(seed):
+    rng = random.Random(seed)
+    machines = [SessionMachine() for _ in range(3)]
+    states = [m.init({}) for m in machines]
+    oracle = _Oracle()
+    sids = ["s0", "s1", "s2", "s3"]
+    keys = ["lk0", "lk1"]
+    idx = 0
+
+    def apply(cmd):
+        nonlocal idx, states
+        idx += 1
+        pre = states[0]
+        outs = [m.apply(_meta(idx), cmd, st)
+                for m, st in zip(machines, states)]
+        outs = [o if len(o) == 3 else (o[0], o[1], []) for o in outs]
+        states = [o[0] for o in outs]
+        fps = {_fingerprint(st) for st in states}
+        assert len(fps) == 1, f"replicas diverged after {cmd!r}"
+        assert len({repr(o[1]) for o in outs}) == 1, \
+            f"replies diverged after {cmd!r}"
+        assert len({repr(o[2]) for o in outs}) == 1, \
+            f"effects diverged after {cmd!r}"
+        oracle.observe(cmd, pre, states[0], outs[0][1], outs[0][2])
+        return outs[0]
+
+    for i in range(400):
+        r = rng.random()
+        sid = rng.choice(sids)
+        key = rng.choice(keys)
+        if r < 0.22:
+            apply(("session_open", sid, 100 + rng.randrange(900)))
+        elif r < 0.34:
+            apply(("session_renew", sid))
+        elif r < 0.42:
+            apply(("session_close", sid))
+        elif r < 0.60:
+            apply(("lock_acquire", sid, key))
+        elif r < 0.70:
+            apply(("lock_acquire", sid, key, "steal"))
+        elif r < 0.82:
+            apply(("lock_release", sid, key))
+        elif r < 0.90:
+            apply(("down", sid, "crash"))
+        else:
+            sess = states[0].sessions.get(sid)
+            if sess is not None:
+                # half live-generation timeouts (real TTL lapse), half
+                # stale (the timer a renewal should have neutralized)
+                gen = sess.gen if rng.random() < 0.5 else max(sess.gen - 1, 0)
+                apply(("timeout", ("session", sid, gen)))
+
+    # teardown: every remaining session goes down; locks must all clear
+    for sid in list(states[0].sessions):
+        apply(("down", sid, "teardown"))
+    assert not states[0].locks, "locks survived all holders dying"
+    assert not states[0].waiters, "waiters survived all sessions dying"
+
+
+def test_stale_timeout_after_renew_is_noop():
+    m = SessionMachine()
+    st = m.init({})
+    st, r, effs = m.apply(_meta(1), ("session_open", "s0", 500), st)
+    assert r == ("ok", 1)
+    assert any(isinstance(e, Monitor) for e in effs)
+    assert any(isinstance(e, Timer) and e.name == ("session", "s0", 1)
+               for e in effs)
+    st, r, _ = m.apply(_meta(2), ("session_renew", "s0"), st)
+    assert r == ("ok", 2)
+    # the old generation's timer fires anyway (it was in flight): no-op
+    out = m.apply(_meta(3), ("timeout", ("session", "s0", 1)), st)
+    st2 = out[0]
+    assert "s0" in st2.sessions and st2.sessions["s0"].gen == 2
+    # the live generation's timer expires for real
+    st3, _, effs = m.apply(_meta(4), ("timeout", ("session", "s0", 2)), st2)
+    assert "s0" not in st3.sessions
+    assert [e.msg[3] for e in effs
+            if isinstance(e, SendMsg) and e.msg[0] == "session_expired"] == ["ttl"]
+
+
+def test_steal_fences_old_holder_and_down_hands_off():
+    m = SessionMachine()
+    st = m.init({})
+    for sid in ("s0", "s1", "s2"):
+        st, _, _ = m.apply(_meta(hash(sid) % 97), ("session_open", sid, 500), st)
+    st, r, _ = m.apply(_meta(10), ("lock_acquire", "s0", "lk"), st)
+    assert r == ("ok", "acquired", 1)
+    st, r, _ = m.apply(_meta(11), ("lock_acquire", "s1", "lk"), st)
+    assert r == ("ok", "queued", None)
+    st, r, effs = m.apply(_meta(12), ("lock_acquire", "s2", "lk", "steal"), st)
+    assert r == ("ok", "stolen", 2)
+    assert ("lock_lost", "lk", 1) in [e.msg for e in effs
+                                      if isinstance(e, SendMsg)]
+    # holder dies -> queued s1 gets the lock with a fresh, higher token
+    st, _, effs = m.apply(_meta(13), ("down", "s2", "crash"), st)
+    assert st.locks["lk"][0] == "s1" and st.locks["lk"][1] == 3
+    assert ("lock_granted", "lk", 3) in [e.msg for e in effs
+                                         if isinstance(e, SendMsg)]
+
+
+def test_handoff_skips_dead_waiters():
+    m = SessionMachine()
+    st = m.init({})
+    for sid in ("s0", "s1", "s2"):
+        st, _, _ = m.apply(_meta(hash(sid) % 89 + 1), ("session_open", sid, 500), st)
+    st, _, _ = m.apply(_meta(20), ("lock_acquire", "s0", "lk"), st)
+    st, _, _ = m.apply(_meta(21), ("lock_acquire", "s1", "lk"), st)
+    st, _, _ = m.apply(_meta(22), ("lock_acquire", "s2", "lk"), st)
+    # first waiter dies while queued, then the holder releases: the lock
+    # must skip s1 and land on s2
+    st, _, _ = m.apply(_meta(23), ("down", "s1", "crash"), st)
+    st, _, effs = m.apply(_meta(24), ("lock_release", "s0", "lk"), st)
+    assert st.locks["lk"][0] == "s2"
+    granted = [e.msg for e in effs if isinstance(e, SendMsg)
+               and e.msg[0] == "lock_granted"]
+    assert [g[0:2] for g in granted] == [("lock_granted", "lk")]
+
+
+def test_close_cancels_timer_and_release_cursor_when_empty():
+    m = SessionMachine()
+    st = m.init({})
+    st, _, _ = m.apply(_meta(1), ("session_open", "s0", 500), st)
+    st, r, effs = m.apply(_meta(2), ("session_close", "s0"), st)
+    assert r == ("ok", None)
+    assert any(isinstance(e, Timer) and e.ms is None for e in effs), \
+        "close must cancel the armed lease timer"
+    assert any(isinstance(e, Demonitor) for e in effs)
+    assert any(isinstance(e, ReleaseCursor) for e in effs), \
+        "empty state after close must release the log cursor"
+
+
+def test_leader_state_enter_rearms_leases_and_monitors():
+    m = SessionMachine()
+    st = m.init({})
+    st, _, _ = m.apply(_meta(1), ("session_open", "s0", 500), st)
+    st, _, _ = m.apply(_meta(2), ("session_open", "s1", 300), st)
+    st, _, _ = m.apply(_meta(3), ("session_renew", "s1"), st)
+    effs = m.state_enter("leader", st)
+    monitors = sorted(e.target for e in effs if isinstance(e, Monitor))
+    timers = sorted(e.name for e in effs if isinstance(e, Timer))
+    assert monitors == ["s0", "s1"]
+    # the re-armed timers carry the CURRENT generations — firing an old
+    # one after failover must stay a no-op
+    assert timers == [("session", "s0", 1), ("session", "s1", 2)]
+    assert m.state_enter("follower", st) == []

@@ -1,0 +1,237 @@
+"""Deterministic simulation plane tests (docs/INTERNALS.md §19).
+
+The tier-1 core is the determinism invariant: a ``Schedule`` fully
+determines execution, so two independent worlds built from the same
+schedule must produce BYTE-IDENTICAL recorded traces and identical
+final replica states — for every workload, with network faults and
+nemesis storms on. Everything else (replayable dumps, the shrinker
+demo on the planted fifo failpoint, transport/scheduler unit behavior)
+leans on that invariant.
+
+The broad seed sweep lives in the ``sim``-marked lane
+(scripts/sim_sweep.sh) with fresh seeds per CI run; here the seeds are
+pinned so failures are immediately reproducible.
+"""
+
+import pytest
+
+import ra_tpu.models.fifo as fifo_mod
+from ra_tpu.sim import (
+    Schedule,
+    SimNetwork,
+    SimScheduler,
+    VirtualClock,
+    dumps,
+    loads,
+    run_schedule,
+    shrink,
+)
+
+FAULTS = dict(drop_p=0.02, dup_p=0.02, delay_p=0.15, nemesis=True)
+
+
+# -- the determinism invariant -------------------------------------------------
+
+
+@pytest.mark.parametrize("workload", ["kv", "fifo", "session"])
+def test_same_seed_same_execution(workload):
+    """Two independent runs of one schedule: byte-identical trace,
+    identical final replica states — under drops, dups, delays,
+    partitions, and crash-restarts."""
+    sched = Schedule(seed=11, workload=workload, **FAULTS)
+    a = run_schedule(sched)
+    b = run_schedule(sched)
+    assert a.trace_text == b.trace_text, \
+        "same schedule produced different executions"
+    assert a.final == b.final
+    assert a.violations == b.violations == []
+    assert a.replies == b.replies
+
+
+@pytest.mark.parametrize("workload", ["kv", "fifo", "session"])
+def test_healthy_run_converges_identically(workload):
+    """No faults: all replicas end at the same applied index with the
+    same state fingerprint."""
+    r = run_schedule(Schedule(seed=5, workload=workload))
+    assert r.ok, r.violations
+    assert len(r.final) == 3
+    assert len({v for v in r.final.values()}) == 1, \
+        f"replicas did not converge: {r.final}"
+
+
+@pytest.mark.parametrize("workload,seed", [("fifo", 23), ("session", 77)])
+def test_schedule_dump_replays_identically(workload, seed):
+    """dumps -> loads round-trips to the same execution: a dumped
+    schedule is a standalone repro with no generator behind it. The
+    session case is the regression for op canonicalization: state
+    digests hash pickle bytes, and ``ast.literal_eval`` in ``loads``
+    never interns strings, so without ``_canon`` a payload string
+    shared by identity between two state slots pickled differently on
+    replay (equal state, different bytes)."""
+    sched = Schedule(seed=seed, workload=workload, **FAULTS)
+    a = run_schedule(sched)
+    reloaded = loads(dumps(a.schedule))
+    assert reloaded.ops == a.schedule.ops
+    b = run_schedule(reloaded)
+    assert b.trace_text == a.trace_text
+    assert b.final == a.final
+
+
+@pytest.mark.parametrize("workload,seed", [
+    ("kv", 5), ("fifo", 23), ("session", 77),
+])
+def test_faulted_runs_converge_after_heal(workload, seed):
+    """Liveness of the settle window: after the horizon heals every
+    fault, all replicas must reach the same applied index and state.
+    Pins two stall bugs: an election timer that was never re-armed
+    after a pre-vote round lost to a partition (no state transition,
+    so the state_enter re-arm never ran), and an await_condition hold
+    wedging forever because the sim shell never armed the
+    generation-tagged ConditionTimeout that proc.py arms."""
+    r = run_schedule(Schedule(seed=seed, workload=workload, **FAULTS))
+    assert r.ok, r.violations
+    assert len(set(r.final.values())) == 1, r.final
+
+
+def test_sim_runs_exercise_faults_and_snapshots():
+    """The schedules must actually reach the interesting machinery:
+    planner storms, crash-restarts, elections, snapshot transfers."""
+    seen = set()
+    for seed in range(3):
+        r = run_schedule(Schedule(seed=seed, workload="kv", **FAULTS))
+        assert r.ok, r.violations
+        for line in r.trace_text.splitlines():
+            seen.add(line.split()[0])
+    assert {"nem", "restart", "etimo", "state", "apply", "net"} <= seen, seen
+    assert "snap" in seen or "install" in seen, \
+        "no snapshot transfer happened across three faulted kv runs"
+
+
+def test_session_timers_fire_under_sim():
+    """Virtual time drives the session machine's lease timers: TTL
+    expiries and lock grants surface as machine-emitted client msgs."""
+    kinds = set()
+    for seed in range(4):
+        r = run_schedule(Schedule(seed=seed, workload="session", **FAULTS))
+        assert r.ok, r.violations
+        kinds |= {msg[0] for _node, _to, msg in r.client_msgs
+                  if isinstance(msg, tuple) and msg}
+    assert "session_expired" in kinds, \
+        "no TTL lease ever lapsed across four session runs"
+
+
+# -- shrinker end-to-end on the planted failpoint --------------------------------
+
+
+def test_explorer_finds_and_shrinks_reversed_requeue_bug(monkeypatch):
+    """End-to-end demo: with the fifo reversed-requeue failpoint on, a
+    faulted schedule trips the per-apply requeue oracle; ddmin shrinks
+    the repro to a handful of ops; the minimized schedule still fails
+    with the bug and passes without it."""
+    monkeypatch.setattr(fifo_mod, "SIM_BUG_REVERSED_REQUEUE", True)
+    sched = Schedule(seed=0, workload="fifo", **FAULTS)
+    r = run_schedule(sched)
+    assert not r.ok, "planted reversed-requeue bug went undetected"
+    assert "requeue order violated" in r.violations[0]
+
+    minimized, replays = shrink(r.schedule)
+    assert len(minimized.ops) <= 10, \
+        f"shrinker left {len(minimized.ops)} ops ({replays} replays)"
+    assert not run_schedule(minimized).ok, \
+        "minimized schedule no longer reproduces the bug"
+
+    monkeypatch.setattr(fifo_mod, "SIM_BUG_REVERSED_REQUEUE", False)
+    assert run_schedule(minimized).ok, \
+        "minimized schedule fails even without the planted bug"
+
+
+def test_shrink_refuses_passing_schedule():
+    sched = Schedule(seed=5, workload="kv")
+    with pytest.raises(ValueError):
+        shrink(sched)
+
+
+# -- component behavior -----------------------------------------------------------
+
+
+def test_virtual_clock_contract():
+    clk = VirtualClock()
+    assert clk.monotonic() == 0.0
+    clk.advance_to(250)
+    assert clk.monotonic() == 0.25
+    assert clk.time() == pytest.approx(1_600_000_000.25)
+    with pytest.raises(RuntimeError):
+        clk.sleep(0.1)  # simulated code must schedule, never block
+    with pytest.raises(ValueError):
+        clk.advance_to(100)  # time never goes backwards
+
+
+def test_scheduler_fifo_tie_break_and_cancel():
+    clk = VirtualClock()
+    sched = SimScheduler(clk)
+    fired = []
+    sched.after_ms(5, lambda: fired.append("a"))
+    sched.after_ms(5, lambda: fired.append("b"))
+    ref = sched.after_ms(3, lambda: fired.append("cancelled"))
+    sched.after_ms(3, lambda: fired.append("c"))
+    sched.cancel(ref)
+    while sched.run_next():
+        pass
+    # same-deadline events run in arrival order; cancelled never fires
+    assert fired == ["c", "a", "b"]
+    assert clk.now_ms == 5
+
+
+def test_transport_blocked_and_dead_refuse_at_sender():
+    clk = VirtualClock()
+    sched = SimScheduler(clk)
+    net = SimNetwork(sched, seed=1)
+    got = []
+    net.attach("n0", lambda to, msg, frm: got.append(("n0", msg, frm)))
+    net.attach("n1", lambda to, msg, frm: got.append(("n1", msg, frm)))
+    a, b = ("srv", "n0"), ("srv", "n1")
+    assert net.send(a, b, "hello")
+    net.block("n0", "n1")
+    assert not net.send(a, b, "blocked"), \
+        "blocked directed pair must refuse at the sender"
+    assert net.send(b, a, "reverse ok"), "blocking is directional"
+    net.unblock_all()
+    while sched.run_next():  # drain BEFORE the detach: in-flight
+        pass                 # messages to a dead node are eaten
+    net.detach("n1")
+    assert not net.send(a, b, "to the dead")
+    while sched.run_next():
+        pass
+    assert [(n, m) for n, m, _f in got] == [("n1", "hello"), ("n0", "reverse ok")]
+
+
+def test_transport_inflight_messages_eaten_by_partition():
+    """A message already in flight when the partition lands is lost —
+    partitions cut the wire, not just future sends."""
+    clk = VirtualClock()
+    sched = SimScheduler(clk)
+    net = SimNetwork(sched, seed=1, base_latency_ms=5)
+    got = []
+    net.attach("n0", lambda to, msg, frm: got.append(msg))
+    net.attach("n1", lambda to, msg, frm: got.append(msg))
+    assert net.send(("srv", "n0"), ("srv", "n1"), "doomed")
+    net.block("n0", "n1")
+    while sched.run_next():
+        pass
+    assert got == []
+
+
+# -- the sim CI lane (fresh seeds come from scripts/sim_sweep.sh) -------------------
+
+
+@pytest.mark.sim
+@pytest.mark.parametrize("workload", ["kv", "fifo", "session"])
+def test_sim_sweep_lane(workload, sim_seed_base):
+    from ra_tpu.sim.explorer import explore
+
+    summary = explore([workload], list(range(sim_seed_base, sim_seed_base + 6)))
+    assert summary["schedules"] == 6
+    for f in summary["failures"]:
+        print(f["minimized"])
+    assert not summary["failures"], \
+        f"{len(summary['failures'])} schedule(s) failed; minimized repros printed above"
