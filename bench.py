@@ -778,6 +778,172 @@ def bench_pipeline(groups: int, cmds: int, wal: bool = True,
             shutil.rmtree(storage[0][4], ignore_errors=True)
 
 
+def bench_reads(groups: int, rounds: int, write_waves: int = 30) -> dict:
+    """Consistent-read throughput, lease on vs the lease-off control
+    (docs/INTERNALS.md §20). Same cluster shape as the pipeline
+    headline (3 batch coordinators, cooperative stage/finish stepping,
+    in-memory logs — reads never touch storage), same methodology for
+    both arms; the ONLY difference is ``lease=True``:
+
+    - lease on: within the quorum-earned window every consistent read
+      serves locally at read_index = commit with ZERO quorum traffic
+      (demand-driven renewal amortizes to one heartbeat round per
+      window);
+    - lease off: every consistent read pays a voter heartbeat quorum
+      round (the Raft read-index protocol) — 2 heartbeats out + 2 acks
+      back per read on a 3-replica group, all through the same step
+      loop.
+
+    Reads go in waves of one query per group; per-read latency is
+    deliver -> reply. A write phase (one command per group per wave)
+    runs first in BOTH arms so the read path has committed state and
+    the write-throughput cost of lease bookkeeping (send-basis stamps,
+    quorum-basis credit per AER ack) is part of the artifact — the
+    claim is local reads for free, not local reads instead of writes."""
+    import numpy as np
+
+    from ra_tpu import obs
+    from ra_tpu.models.bench_machine import BenchMachine
+    from ra_tpu.ops import consensus as C
+    from ra_tpu.protocol import Command, ElectionTimeout, USR
+    from ra_tpu.runtime.coordinator import BatchCoordinator
+
+    def one_arm(tag: str, lease: bool) -> dict:
+        coords = [
+            BatchCoordinator(f"{tag}{i}", capacity=groups, num_peers=3,
+                             idle_sleep_s=0, pipeline=True, lease=lease)
+            for i in range(3)
+        ]
+        names = [f"g{g}" for g in range(groups)]
+        try:
+            members = lambda g: [(g, f"{tag}{i}") for i in range(3)]  # noqa: E731
+            for c in coords:
+                c.add_groups([(g, f"cl_{g}", members(g), BenchMachine(), None)
+                              for g in names])
+            coords[0].deliver_many(
+                [((g, f"{tag}0"), ElectionTimeout(), None) for g in names]
+            )
+
+            def step_all() -> bool:
+                worked = False
+                for c in coords:
+                    worked = c.step_stage() or worked
+                for c in coords:
+                    worked = c.step_finish() or worked
+                return worked
+
+            by = coords[0].by_name
+            deadline = time.time() + 300
+            while time.time() < deadline and not all(
+                by[g].role == C.R_LEADER for g in names
+            ):
+                if not step_all():
+                    time.sleep(0.001)
+            if not all(by[g].role == C.R_LEADER for g in names):
+                raise TimeoutError("read bench: election incomplete")
+            while step_all():
+                pass
+
+            # write phase: lease bookkeeping rides the AER path, so the
+            # write rate is the "within noise" control across arms —
+            # best of 3 passes, same hedge as the headline bench (a
+            # single short pass on a shared 1-core box measures load
+            # spikes as often as the framework)
+            cmd = Command(kind=USR, data=1, reply_mode="noreply")
+            base = coords[0]._applied_np[:groups].copy()
+            writes_per_sec = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _w in range(write_waves):
+                    base += 1
+                    coords[0].deliver_commands(names, cmd)
+                    while not all(
+                        (c._applied_np[:groups] >= base).all()
+                        for c in coords
+                    ):
+                        if not step_all():
+                            time.sleep(0)
+                writes_per_sec = max(
+                    writes_per_sec,
+                    groups * write_waves / (time.perf_counter() - t0),
+                )
+
+            h = obs.histogram(
+                (tag, "read_latency"),
+                help="consistent read latency: deliver -> reply")
+            h.reset()
+            got = [0]
+            bad = [0]
+
+            def probe(s):
+                return s
+
+            def on_reply(out, _h=h):
+                if out[0] != "ok":
+                    bad[0] += 1
+                got[0] += 1
+
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                n0 = got[0]
+                tw = time.perf_counter()
+                coords[0].deliver_many(
+                    [((g, f"{tag}0"), ("consistent_query", probe, on_reply),
+                      None) for g in names]
+                )
+                want = (r + 1) * groups
+                while got[0] < want:
+                    if time.time() > deadline:
+                        raise TimeoutError("read bench: wave incomplete")
+                    if not step_all():
+                        time.sleep(0)
+                    now = time.perf_counter()
+                    if got[0] > n0:
+                        h.record_seconds(now - tw, count=got[0] - n0)
+                        n0 = got[0]
+            dt = time.perf_counter() - t0
+            if bad[0]:
+                raise RuntimeError(f"read bench: {bad[0]} non-ok replies")
+            ctr = lambda k: int(sum(c.counters.get(k) for c in coords))  # noqa: E731
+            return {
+                "lease": lease,
+                "reads": got[0],
+                "reads_per_sec": round(got[0] / dt, 1),
+                "read_p50_ms": round(h.percentile(50) / 1e6, 3),
+                "read_p90_ms": round(h.percentile(90) / 1e6, 3),
+                "read_p99_ms": round(h.percentile(99) / 1e6, 3),
+                "writes_per_sec": round(writes_per_sec, 1),
+                "read_lease_served": ctr("read_lease_served"),
+                "read_quorum_fallback": ctr("read_quorum_fallback"),
+                "lease_expirations": ctr("read_lease_expirations"),
+            }
+        finally:
+            for c in coords:
+                c.stop()
+
+    on = one_arm("rdl", True)
+    off = one_arm("rdq", False)
+    return {
+        "metric": (
+            f"linearizable consistent-read throughput ({groups} groups x 3 "
+            f"replicas, tpu_batch coordinators, cooperative pipelined "
+            f"stepping, {rounds} waves of one read per group; "
+            f"lease arm serves at read_index = commit under a "
+            f"quorum-earned clock-bound lease, control arm pays a voter "
+            f"heartbeat quorum round per read; write phase "
+            f"({write_waves} waves) is the bookkeeping-cost control; "
+            f"p50/p99 = deliver -> reply)"
+        ),
+        "value": on["reads_per_sec"],
+        "unit": "reads/sec",
+        "lease_on": on,
+        "lease_off": off,
+        "read_speedup": round(on["reads_per_sec"] / off["reads_per_sec"], 2),
+        "write_ratio": round(on["writes_per_sec"] / off["writes_per_sec"], 3),
+        "vs_baseline": round(on["reads_per_sec"] / 100_000.0, 3),
+    }
+
+
 def bench_decisions(groups: int, steps: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -829,6 +995,10 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true", help="small/fast run")
     ap.add_argument("--decisions", action="store_true",
                     help="raw decision-kernel throughput instead of pipeline")
+    ap.add_argument("--reads", action="store_true",
+                    help="consistent-read throughput, lease on vs the "
+                         "lease-off quorum-round control "
+                         "(docs/INTERNALS.md §20)")
     ap.add_argument("--no-wal", action="store_true",
                     help="in-memory logs: host routing ceiling (the "
                          "headline default is WAL-backed/durable)")
@@ -862,6 +1032,9 @@ def main() -> None:
     if args.decisions:
         g = args.groups or (1024 if args.smoke else 10240)
         out = bench_decisions(g, args.steps or (10 if args.smoke else 200))
+    elif args.reads:
+        g = args.groups or (64 if args.smoke else 256)
+        out = bench_reads(g, args.cmds or (10 if args.smoke else 60))
     else:
         # 96 commands in flight per group — deep pipelining is the
         # reference harness's own methodology (PIPE_SIZE=500 in-flight

@@ -47,6 +47,22 @@ class RaError(Exception):
     pass
 
 
+class StaleReadError(RaError):
+    """A bounded local read (``local_query`` with ``max_staleness_s``)
+    could not be served within the requested staleness bound
+    (docs/INTERNALS.md §20). ``staleness`` is the replica's provable
+    upper bound (``inf`` until it has applied a leader freshness
+    stamp); ``leader_hint`` names where a linearizable retry can go."""
+
+    def __init__(self, staleness: float, leader_hint):
+        super().__init__(
+            f"local read exceeds staleness bound: {staleness:.3f}s "
+            f"(leader hint: {leader_hint})"
+        )
+        self.staleness = staleness
+        self.leader_hint = leader_hint
+
+
 def _node(node_name: str) -> RaNode:
     node = node_registry().get(node_name)
     if node is None:
@@ -127,11 +143,17 @@ def start_server(
     members: Sequence[ServerId],
     machine_config: Optional[dict] = None,
     machine_factory: Optional[str] = None,
+    extra_cfg: Optional[dict] = None,
 ) -> ServerId:
+    """``extra_cfg`` carries optional ServerConfig knobs (e.g.
+    ``{"lease": True}``, docs/INTERNALS.md §20); it is persisted with
+    the server config so restarts keep the same behavior. Local nodes
+    only — remote management calls ignore it."""
     name, node_name = server_id
     return _mgmt_route(node_name).start_server(
         name, cluster_name, machine, tuple(members),
         machine_config=machine_config, machine_factory=machine_factory,
+        _extra_cfg=extra_cfg,
     )
 
 
@@ -140,13 +162,15 @@ def start_cluster(
     machine_factory: Callable[[], Machine],
     server_ids: Sequence[ServerId],
     timeout: float = 5.0,
+    extra_cfg: Optional[dict] = None,
 ) -> Tuple[List[ServerId], List[ServerId]]:
     """Start all members (in parallel, like the reference's
     partition_parallel cluster start), elect a leader, return
     (started, failed)."""
     ids = list(server_ids)
     oks, errs = partition_parallel(
-        lambda sid: start_server(sid, cluster_name, machine_factory(), ids),
+        lambda sid: start_server(sid, cluster_name, machine_factory(), ids,
+                                 extra_cfg=extra_cfg),
         ids,
         timeout_s=timeout,
     )
@@ -455,46 +479,92 @@ def register_client(node_name: str, who: Any, cb: Callable[[ServerId, list], Non
 # queries
 
 
-def local_query(server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0):
-    """Query any member's machine state directly (possibly stale)."""
+# leader-bound queries chase at most this many member-supplied
+# redirect hints before falling back to the leaderboard; during churn
+# two deposed members can point at each other indefinitely otherwise
+MAX_REDIRECT_HOPS = 4
+
+
+def local_query(server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0,
+                max_staleness_s: Optional[float] = None):
+    """Query any member's machine state directly (possibly stale).
+
+    ``max_staleness_s`` bounds the staleness instead of accepting any:
+    the member answers only when its leader-stamped freshness floor
+    proves its applied state is at most that many (leader wall-clock)
+    seconds old, and raises ``StaleReadError`` otherwise
+    (docs/INTERNALS.md §20). Requires the cluster to run with leases
+    enabled — lease-off leaders never stamp, so every bounded read
+    then fails conservatively."""
     fut = Future()
-    if not _try_send(server_id, ("local_query", fn, fut)):
+    msg = (
+        ("local_query", fn, fut) if max_staleness_s is None
+        else ("local_query", fn, fut, max_staleness_s)
+    )
+    if not _try_send(server_id, msg):
         raise RaError(f"server {server_id} unreachable")
-    return fut.result(timeout)
+    out = fut.result(timeout)
+    if out[0] == "stale":
+        raise StaleReadError(out[1], out[2])
+    return out
 
 
 def leader_query(server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0):
     """Query the leader's (uncommitted-read) machine state."""
+    deadline = time.monotonic() + timeout
     cluster = _cluster_of(server_id)
-    leader = leaderboard.lookup_leader(cluster or "") or server_id
-    fut = Future()
-    if not _try_send(leader, ("leader_query", fn, fut)):
-        raise RaError(f"leader {leader} unreachable")
-    out = fut.result(timeout)
-    if out[0] == "redirect":
+    target = leaderboard.lookup_leader(cluster or "") or server_id
+    for hop in range(MAX_REDIRECT_HOPS + 1):
+        fut = Future()
+        if not _try_send(target, ("leader_query", fn, fut)):
+            raise RaError(f"leader {target} unreachable")
+        out = fut.result(max(0.05, deadline - time.monotonic()))
+        if out[0] != "redirect":
+            return out
         if out[1] is None:
             raise RaError("no leader")
-        return leader_query(out[1], fn, timeout)
-    return out
+        # hop 1 trusts the member's hint; after that the hints have
+        # proven stale — re-consult the leaderboard before giving up
+        if hop >= 1 and cluster:
+            target = leaderboard.lookup_leader(cluster) or out[1]
+        else:
+            target = out[1]
+    raise RaError(
+        f"leader_query exceeded {MAX_REDIRECT_HOPS} redirect hops"
+    )
 
 
 def consistent_query(
     server_id: ServerId, fn: Callable[[Any], Any], timeout: float = 5.0
 ):
-    """Linearizable read: the leader confirms leadership with a quorum
-    heartbeat round before answering (reference: heartbeat query_index
-    protocol)."""
+    """Linearizable read: served locally under a valid leader lease,
+    otherwise the leader confirms leadership with a quorum heartbeat
+    round before answering (reference: heartbeat query_index protocol;
+    docs/INTERNALS.md §20)."""
     deadline = time.monotonic() + timeout
     cluster = _cluster_of(server_id)
     target = leaderboard.lookup_leader(cluster or "") or server_id
+    hops = 0
     while time.monotonic() < deadline:
         fut = Future()
         if not _try_send(target, ("consistent_query", fn, fut)):
             time.sleep(0.02)
+            target = leaderboard.lookup_leader(cluster or "") or server_id
             continue
         out = fut.result(max(0.05, deadline - time.monotonic()))
         if out[0] == "redirect":
-            target = out[1] or target
+            hops += 1
+            if hops > MAX_REDIRECT_HOPS:
+                # stale hints chasing each other during churn: pause a
+                # beat, then restart routing from the leaderboard
+                hops = 0
+                time.sleep(0.02)
+                target = (
+                    leaderboard.lookup_leader(cluster or "") or server_id
+                )
+                continue
+            target = out[1] or leaderboard.lookup_leader(cluster or "") \
+                or target
             continue
         return out
     raise RaError("consistent_query timed out")

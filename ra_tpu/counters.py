@@ -68,6 +68,23 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
     ("local_queries", "counter", "local queries served"),
     ("leader_queries", "counter", "leader queries served"),
     ("consistent_queries", "counter", "consistent queries served"),
+    # -- lease-based local reads (docs/INTERNALS.md §20) ----------------
+    ("read_lease_served", "counter",
+     "consistent queries served locally under a valid leader lease "
+     "(zero quorum traffic)"),
+    ("read_quorum_fallback", "counter",
+     "consistent queries that fell back to a quorum heartbeat round "
+     "(lease off, invalid, or not yet earned)"),
+    ("read_lease_expirations", "counter",
+     "leases found lapsed at read admission (each lapse counted once)"),
+    ("read_lease_revocations", "counter",
+     "leases revoked eagerly on deposition/stepdown/transfer/"
+     "membership change"),
+    ("read_stale_rejected", "counter",
+     "bounded local queries rejected because the freshness floor "
+     "exceeded the caller's max_staleness_s"),
+    ("read_local_bounded", "counter",
+     "local queries served under an explicit max_staleness_s bound"),
     ("read_issued", "counter", "log reads issued"),
     ("read_cache", "counter", "log reads served from memtable"),
     ("read_segment", "counter", "log reads served from segments"),
@@ -129,6 +146,21 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
      "aggregate applied-entries/sec across this coordinator's groups "
      "(leaky-integrator smoothed, sampled per tick — the batch-backend "
      "feed for placement/leader-balancing decisions)"),
+    # -- lease-based local reads, batch backend (§20) -------------------
+    ("read_lease_served", "counter",
+     "consistent queries served locally under a valid group lease "
+     "(checked against the vectorized (G,) expiry array)"),
+    ("read_quorum_fallback", "counter",
+     "consistent queries that fell back to a quorum heartbeat round"),
+    ("read_lease_expirations", "counter",
+     "group leases found lapsed at read admission"),
+    ("read_lease_revocations", "counter",
+     "group leases revoked on deposition/term-adoption/transfer/"
+     "membership change"),
+    ("read_stale_rejected", "counter",
+     "bounded local queries rejected past max_staleness_s"),
+    ("read_local_bounded", "counter",
+     "local queries served under an explicit max_staleness_s bound"),
     ("pipeline_steps", "counter",
      "device steps dispatched via the pipelined wave loop (stage/"
      "finish drivers or the started two-stage loop); pair with "

@@ -400,7 +400,35 @@ class HealthScanner:
                 "lagging": c.get("health_lagging"),
                 "quiet": c.get("health_quiet"),
             },
+            "reads": self._read_totals(),
         }
+
+    # read-path totals (docs/INTERNALS.md §20) summed over this node's
+    # server/coordinator counter sets; cumulative, so consumers like
+    # scripts/ra_top.py can difference successive snapshots into reads/s
+    _READ_FIELDS = ("read_lease_served", "read_quorum_fallback",
+                    "read_local_bounded", "read_stale_rejected")
+
+    def _read_totals(self) -> Dict[str, int]:
+        tot = dict.fromkeys(self._READ_FIELDS, 0)
+        reg = ra_counters.registry()
+        for key in reg.names():
+            mine = key == ("coordinator", self.node) or (
+                isinstance(key, tuple) and len(key) == 2
+                and isinstance(key[1], tuple) and len(key[1]) == 2
+                and key[1][1] == self.node
+            )
+            if not mine:
+                continue
+            cs = reg.fetch(key)
+            if cs is None:
+                continue
+            for f in self._READ_FIELDS:
+                try:
+                    tot[f] += cs.get(f)
+                except KeyError:
+                    pass  # counter set without read fields
+        return tot
 
 
 # ---------------------------------------------------------------------------

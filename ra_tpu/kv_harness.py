@@ -158,6 +158,7 @@ def run(
     workload: str = "kv",
     combined: bool = False,
     native: str = "auto",
+    lease: bool = False,
 ) -> HarnessResult:
     """``rescue=True`` lets the harness fire operator election kicks on
     a stuck deployment (useful when hunting consistency bugs past a
@@ -187,7 +188,15 @@ def run(
     hot-loop runtime paths (docs/INTERNALS.md §18; "auto"/"off" or a
     comma list of pack,classify,egress) — the soak grid runs both so
     the disk-fault/torn-write failpoints are proven to bite through the
-    native fallback seam."""
+    native fallback seam.
+
+    ``lease=True`` is the linearizable-read dimension (docs/
+    INTERNALS.md §20): servers run with clock-bound leader leases so
+    consistent reads serve locally, one-way partitions join the nemesis
+    mix, and the workload periodically forces a deposition via
+    ``api.transfer_leadership`` mid-read-stream — every consistent read
+    is still checked against the reference model, so a lease that
+    outlives its leader shows up as a stale read."""
     if combined:
         partitions = True
         membership = True
@@ -204,13 +213,13 @@ def run(
         return _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                           membership, op_timeout, rescue, disk_faults,
                           overload=overload, workload=workload,
-                          combined=combined)
+                          combined=combined, lease=lease)
     if backend == "tpu_batch":
         return _run_batch(seed, n_ops, nodes, partitions, membership,
                           op_timeout, rescue, restarts=restarts,
                           disk_faults=disk_faults, data_dir=data_dir,
                           overload=overload, rings=rings, workload=workload,
-                          combined=combined, native=native)
+                          combined=combined, native=native, lease=lease)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -657,7 +666,7 @@ class _FifoWorkload:
 def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                membership, op_timeout, rescue=False,
                disk_faults=False, overload=False, workload="kv",
-               combined=False) -> HarnessResult:
+               combined=False, lease=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.machine import register_machine_factory
@@ -687,7 +696,9 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
     ids = [(f"kv{i}", names[i]) for i in range(nodes)]
     spare = (f"kv{nodes}", names[nodes])
     cluster = list(ids)
-    api.start_cluster(f"kvhc{seed}", mach_cls, ids, timeout=20)
+    extra_cfg = {"lease": True} if lease else None
+    api.start_cluster(f"kvhc{seed}", mach_cls, ids, timeout=20,
+                      extra_cfg=extra_cfg)
     model = _Model()
     counts: Dict[str, int] = {}
     # rescue randomness separate from the workload stream (seed
@@ -730,7 +741,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
             elif spare not in cluster:
                 api.start_server(
                     spare, f"kvhc{seed}", None, cluster + [spare],
-                    machine_factory=factory_name,
+                    machine_factory=factory_name, extra_cfg=extra_cfg,
                 )
                 out = api.add_member(cluster[0], spare, timeout=op_timeout)
                 if out[0] == "ok":
@@ -759,7 +770,8 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
         return sent
 
     dims = nem.standard_dimensions(
-        partitions=partitions, oneway=combined, disk_faults=disk_faults,
+        partitions=partitions, oneway=combined or lease,
+        disk_faults=disk_faults,
         restarts=restarts, membership=membership, overload=combined,
         mode_flips=False)
     ctx = nem.NemesisContext(
@@ -855,6 +867,17 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                     write(("delete", key))
                 elif roll < 0.8:
                     counts["get"] = counts.get("get", 0) + 1
+                    if lease and counts["get"] % 5 == 0:
+                        # deposition raced against the read stream: the
+                        # lease must be revoked before the new leader
+                        # answers, or the next read comes back stale
+                        counts["transfer"] = counts.get("transfer", 0) + 1
+                        try:
+                            api.transfer_leadership(
+                                rng.choice(cluster), rng.choice(cluster),
+                                timeout=op_timeout)
+                        except Exception:  # noqa: BLE001 — no leader now
+                            pass
                     try:
                         out = api.consistent_query(
                             rng.choice(cluster), lambda s: dict(s),
@@ -1020,7 +1043,7 @@ def _dump_on_failure(failures, label: str, anomalies=None,
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
                data_dir=None, overload=False, rings=True, workload="kv",
-               combined=False, native="auto") -> HarnessResult:
+               combined=False, native="auto", lease=False) -> HarnessResult:
     import tempfile
 
     from ra_tpu.log.log import Log
@@ -1123,6 +1146,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
             rings=rings,
             native=native,
             send_msg_cb=fifo_sink,
+            lease=lease,
         )
         if use_disk:
             storage[n]["ref"]["c"] = c
@@ -1234,7 +1258,8 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
         return coords[names[0]].active_set
 
     dims = nem.standard_dimensions(
-        partitions=partitions, oneway=combined, disk_faults=disk_faults,
+        partitions=partitions, oneway=combined or lease,
+        disk_faults=disk_faults,
         restarts=use_disk and restarts, membership=membership,
         overload=combined, mode_flips=combined)
     ctx = nem.NemesisContext(
@@ -1314,6 +1339,15 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                     write(("delete", key))
                 elif roll < 0.85:
                     counts["get"] = counts.get("get", 0) + 1
+                    if lease and counts["get"] % 5 == 0:
+                        # deposition mid-read-stream: see _run_actor
+                        counts["transfer"] = counts.get("transfer", 0) + 1
+                        try:
+                            api.transfer_leadership(
+                                rng.choice(cluster), rng.choice(cluster),
+                                timeout=op_timeout)
+                        except Exception:  # noqa: BLE001
+                            pass
                     try:
                         out = api.consistent_query(
                             rng.choice(cluster), lambda s: dict(s),
@@ -1483,12 +1517,17 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
                     help="batch backend native hot-loop runtime paths: "
                          "auto (default), off, or a comma list of "
                          "pack,classify,egress (docs/INTERNALS.md §18)")
+    ap.add_argument("--lease", action="store_true",
+                    help="linearizable-read dimension: clock-bound "
+                         "leader leases on, one-way partitions in the "
+                         "nemesis mix, forced depositions racing the "
+                         "consistent-read stream (docs/INTERNALS.md §20)")
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
               restarts=args.restarts, disk_faults=args.disk_faults,
               overload=args.overload, rings=args.rings == "on",
               workload=args.workload, combined=args.combined,
-              native=args.native)
+              native=args.native, lease=args.lease)
     print(f"ops={res.ops} consistent={res.consistent}")
     if res.nemesis:
         fired = {k: v for k, v in res.nemesis.items() if v}

@@ -283,6 +283,19 @@ def wave_hists(node_name: str) -> Dict[str, LogHistogram]:
     }
 
 
+def staleness_hist(node_name: str) -> LogHistogram:
+    """Observed staleness bound claimed at each bounded local read
+    (api.local_query max_staleness_s path, docs/INTERNALS.md §20) —
+    recorded in ns of leader wall time, whether the read was served or
+    rejected, so the distribution shows how fresh followers really run."""
+    return histogram(
+        ("follower_read_staleness", node_name),
+        help="leader-stamped staleness bound evaluated for bounded "
+             "local reads (max_staleness_s, docs/INTERNALS.md §20)",
+        locked=True,
+    )
+
+
 def commit_hists(node_name: str) -> Dict[str, LogHistogram]:
     # locked: one family per NODE, but every actor server on the node
     # (scheduler worker threads) and any coordinator step thread write

@@ -183,6 +183,11 @@ class AppendEntriesRpc:
     # per-entry specials/cluster scan on the write hot path; False is
     # always safe (receiver scans).
     plain_usr: bool = False
+    # leader wall-clock stamp taken while leader_commit was current
+    # (staleness-bounded follower reads, docs/INTERNALS.md §20). 0.0
+    # when the sender runs lease-off — receivers then never advance
+    # their freshness floor and bounded local reads stay conservative.
+    commit_ts: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,6 +206,11 @@ class RequestVoteRpc:
     candidate_id: ServerId
     last_log_index: int
     last_log_term: int
+    # leadership-transfer (TimeoutNow) and force_shrink candidacies set
+    # this so voters skip leader stickiness (§20): the old leader
+    # revoked its lease before soliciting the vote, so deposing it
+    # early is safe. Ordinary elections leave it False.
+    force: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -142,7 +142,7 @@ class GroupHost:
         "noop_index", "noop_committed", "query_seq", "cluster_history",
         "last_ack", "aux_state", "aux_inited", "last_contact", "low_q",
         "specials", "last_ok_sent", "fresh_tail", "match_hint", "lat",
-        "_clock",
+        "_clock", "fresh_anchor", "fresh_ts", "lease_contact",
     )
 
     def __init__(self, gid, name, cluster_name, members, self_slot, log, machine,
@@ -255,6 +255,17 @@ class GroupHost:
         # in monotonic ns. Only sampled groups (gid & lat_mask == 0)
         # for commands carrying a submit ts ever allocate one.
         self.lat: Optional[list] = None
+        # staleness-bounded follower reads (docs/INTERNALS.md §20):
+        # fresh_ts is the newest leader wall-clock stamp whose commit
+        # point this replica has fully applied; fresh_anchor holds a
+        # (leader_commit, commit_ts) pair still waiting for apply to
+        # catch up. lease_contact is the leader-contact stamp backing
+        # the stickiness promise (AER/heartbeat/snapshot only — NOT
+        # the election-suspicion last_contact, which also restarts on
+        # role changes and vote grants).
+        self.fresh_anchor: Tuple[int, float] = (0, 0.0)
+        self.fresh_ts = 0.0
+        self.lease_contact = 0.0
 
     def slot_of(self, sid: ServerId) -> int:
         try:
@@ -296,6 +307,9 @@ class BatchCoordinator:
         egress_async: bool = True,
         native: str = "auto",
         clock=None,
+        lease: bool = False,
+        lease_safety_factor: float = 0.8,
+        lease_drift_epsilon_s: float = 0.002,
     ):
         from ra_tpu.runtime.clock import WALL
 
@@ -482,6 +496,27 @@ class BatchCoordinator:
         self._egress_rings = ring_cls(lane_slots=4096,
                                       wake=self._egress_wake)
         self._sender_thread: Optional[threading.Thread] = None
+        # clock-bound leader leases, vectorized over the group axis
+        # (docs/INTERNALS.md §20): per-slot oldest-outstanding-send
+        # stamps and credited ack bases, folded into a (G,) expiry
+        # column by _lease_refresh over just the dirty gids. Off by
+        # default — leader stickiness changes election behavior.
+        from ra_tpu.lease import LeaseConfig
+
+        self.lease_cfg = LeaseConfig(
+            enabled=lease, election_timeout_s=election_timeout_s,
+            safety_factor=lease_safety_factor,
+            drift_epsilon_s=lease_drift_epsilon_s,
+        )
+        self._lease_sent = np.zeros((capacity, num_peers), np.float64)
+        self._lease_basis = np.zeros((capacity, num_peers), np.float64)
+        self._lease_expiry = np.zeros(capacity, np.float64)
+        self._lease_voters = np.zeros((capacity, num_peers), bool)
+        self._lease_quorum = np.zeros(capacity, np.int64)
+        self._lease_self = np.zeros(capacity, np.int64)
+        self._lease_renew_t = np.zeros(capacity, np.float64)
+        self._lease_dirty: set = set()
+        self._stale_h = None  # lazy follower_read_staleness histogram
         # role transitions queued by rare paths, applied as ONE scatter
         # at the start of the next step (an election storm over many
         # groups must not pay one jitted scatter per group)
@@ -943,6 +978,8 @@ class BatchCoordinator:
                          li, lt, sidx, sterm))
             hosts.append((name, g))
             sids.append(sid)
+            if self.lease_cfg.enabled:
+                self._lease_sync(g)
         if rows:
             gids = jnp.asarray(np.array([r[0] for r in rows], np.int32))
             act = jnp.asarray(np.stack([r[1] for r in rows]))
@@ -1955,12 +1992,29 @@ class BatchCoordinator:
         if t in MSG_OF_TYPE:
             if t is AppendEntriesRpc and msg.term >= g.term:
                 g.last_contact = now_mono
+                if self.lease_cfg.enabled:
+                    # leader contact backing the stickiness promise,
+                    # plus the follower freshness anchor for bounded
+                    # local reads (docs/INTERNALS.md §20)
+                    g.lease_contact = now_mono
+                    if msg.commit_ts > g.fresh_anchor[1]:
+                        if g.last_applied >= msg.leader_commit:
+                            if msg.commit_ts > g.fresh_ts:
+                                g.fresh_ts = msg.commit_ts
+                        else:
+                            g.fresh_anchor = (
+                                msg.leader_commit, msg.commit_ts
+                            )
             # host-side next_index bookkeeping rides on the same replies
             # the device will process
             elif t is AppendEntriesReply and g.role == C.R_LEADER:
                 slot = g.slot_of(from_sid)
                 if slot >= 0:
                     g.last_ack[slot] = now_mono
+                    if self.lease_cfg.enabled and msg.term == g.term:
+                        # any same-term reply (success or reject)
+                        # proves contact: credit the send basis
+                        self._lease_credit(g, slot)
                     if msg.success:
                         g.next_index[slot] = max(g.next_index[slot], msg.last_index + 1)
                         if slot < len(g.match_hint):
@@ -1987,6 +2041,30 @@ class BatchCoordinator:
                         hint = max(1, min(msg.next_index, msg.last_index + 1))
                         g.next_index[slot] = min(g.next_index[slot], hint)
                     aer_dirty.add(g.gid)
+            elif (
+                self.lease_cfg.enabled
+                and (t is PreVoteRpc or t is RequestVoteRpc)
+                and not (t is RequestVoteRpc and msg.force)
+                and g.slot_of(msg.candidate_id) != g.leader_slot
+                and not self._stickiness_lapsed(g, now_mono)
+            ):
+                # leader stickiness (§20): within one election timeout
+                # of leader contact, (pre-)votes for other candidates
+                # are disregarded — denied at OUR term, without letting
+                # the device adopt the higher term (the term echo would
+                # depose the live leader the lease depends on).
+                # TimeoutNow-forced candidacies bypass: the old leader
+                # revoked its lease before soliciting the vote.
+                deny = (
+                    PreVoteResult(g.term, msg.token, False)
+                    if t is PreVoteRpc
+                    else RequestVoteResult(g.term, False)
+                )
+                out = route_out.get(msg.candidate_id[1])
+                if out is None:
+                    route_out[msg.candidate_id[1]] = out = []
+                out.append((msg.candidate_id, deny, (g.name, self.name)))
+                return
             g.inbox.append((from_sid, msg))
             self._hot.add(g.gid)
             return
@@ -2265,6 +2343,10 @@ class BatchCoordinator:
             active=self.state.active.at[g.gid].set(jnp.asarray(active)),
             voting=self.state.voting.at[g.gid].set(jnp.asarray(voting)),
         )
+        if self.lease_cfg.enabled:
+            if g.role == C.R_LEADER:
+                self._lease_revoke(g, "membership change")
+            self._lease_sync(g)
 
     def _adopt_cluster_cmd(self, g: GroupHost, cmd: Command, entry_index: int = 0) -> None:
         """Follower-side adoption of a replicated cluster change (slot
@@ -2730,6 +2812,7 @@ class BatchCoordinator:
                     # answered from this replica's state, and pending
                     # command futures must redirect rather than hang
                     # their clients until timeout
+                    self._lease_revoke(g, "left leader")
                     for q in g.pending_queries:
                         self._reply(q["fut"], ("redirect", None))
                     g.pending_queries = []
@@ -2908,6 +2991,15 @@ class BatchCoordinator:
             g.pending_ack = (from_sid, last_entry)
 
     def _on_became_leader(self, g: GroupHost, aer_dirty) -> None:
+        if self.lease_cfg.enabled:
+            # fresh leadership starts bare: the lease is earned by this
+            # term's own acks, never inherited from stale stamps
+            gid = g.gid
+            self._lease_expiry[gid] = 0.0
+            self._lease_sent[gid, :] = 0.0
+            self._lease_basis[gid, :] = 0.0
+            self._lease_renew_t[gid] = 0.0
+            self._lease_dirty.discard(gid)
         li, _ = g.log.last_index_term()
         g.next_index = [li + 1] * len(g.members)
         g.commit_sent = [0] * len(g.members)
@@ -2985,6 +3077,8 @@ class BatchCoordinator:
                 g.machine_state = batched
                 g.last_applied = hi
                 self._applied_np[g.gid] = hi
+                if self.lease_cfg.enabled:
+                    self._lease_applied(g, hi)
                 if lat is not None:
                     # noreply pipeline shape: the reply stage is the
                     # post-apply bookkeeping fan-out (no future owed)
@@ -3070,6 +3164,8 @@ class BatchCoordinator:
         g.machine_state = state
         g.last_applied = hi
         self._applied_np[g.gid] = hi
+        if self.lease_cfg.enabled:
+            self._lease_applied(g, hi)
         if lat is not None:
             # tracked entry was non-USR (rare): close the sample here
             now2 = time.monotonic_ns()
@@ -3307,7 +3403,136 @@ class BatchCoordinator:
         for to, msg, frm in msgs:
             self.transport.send(to, msg, from_sid=frm)
 
-    def _broadcast_vote_req(self, g: GroupHost, queue_send, pre: bool) -> None:
+    # -- leases (docs/INTERNALS.md §20) ------------------------------------
+
+    def _lease_sync(self, g: GroupHost) -> None:
+        """Mirror the group's voter set into the lease arrays. Runs at
+        registration and on every membership scatter; a membership
+        change while leading revokes (the old lease quorum may not
+        intersect the new vote quorum)."""
+        voting = np.zeros(self.P, dtype=bool)
+        for i, m in enumerate(g.members):
+            if m is not None and g.voter_status.get(i) == "voter":
+                voting[i] = True
+        self._lease_voters[g.gid] = voting
+        self._lease_quorum[g.gid] = int(voting.sum()) // 2 + 1
+        self._lease_self[g.gid] = g.self_slot
+
+    def _lease_stamp_send(self, gid: int, slot: int, now: float) -> None:
+        """Oldest-outstanding-send stamp for one peer slot (later sends
+        before an ack keep the older, more conservative stamp)."""
+        if self._lease_sent[gid, slot] == 0.0:
+            self._lease_sent[gid, slot] = now
+
+    def _lease_credit(self, g: GroupHost, slot: int) -> None:
+        """Fold a same-term response from ``slot`` into its ack basis
+        (send-basis rule — never the receive time)."""
+        gid = g.gid
+        t0 = self._lease_sent[gid, slot]
+        if t0 == 0.0:
+            return
+        self._lease_sent[gid, slot] = 0.0
+        if t0 > self._lease_basis[gid, slot]:
+            self._lease_basis[gid, slot] = t0
+            self._lease_dirty.add(gid)
+
+    def _lease_refresh(self) -> None:
+        """Recompute expiries for groups with newly credited bases: one
+        vectorized k-th-largest pass over the dirty set (the (G,)-array
+        analog of LeaseTracker.refresh). Expiry only ever advances."""
+        d = self._lease_dirty
+        if not d:
+            return
+        from ra_tpu.lease import lease_expiry, quorum_bases
+
+        gids = np.fromiter(d, np.int64, len(d))
+        d.clear()
+        now = self.clock.monotonic()
+        bases = self._lease_basis[gids].copy()
+        # the leader's own slot always counts as an ack at ``now``
+        bases[np.arange(len(gids)), self._lease_self[gids]] = now
+        qb = quorum_bases(bases, self._lease_voters[gids],
+                          self._lease_quorum[gids])
+        cfg = self.lease_cfg
+        exp = np.where(qb > 0.0, lease_expiry(
+            qb, cfg.election_timeout_s, cfg.safety_factor,
+            cfg.drift_epsilon_s), 0.0)
+        cur = self._lease_expiry[gids]
+        fresh = (exp > now) & (cur <= now) & (exp > cur)
+        self._lease_expiry[gids] = np.maximum(cur, exp)
+        if fresh.any():
+            for gid in gids[fresh].tolist():
+                g = self.groups[gid]
+                if g is not None:
+                    self._obs_rec.record(
+                        "lease_acquired", node=self.name, group=g.name,
+                        term=g.term,
+                        detail=f"expires in "
+                               f"{self._lease_expiry[gid] - now:.3f}s",
+                    )
+
+    def _lease_revoke(self, g: GroupHost, why: str) -> None:
+        """Eager revocation: clears the expiry AND the stamp/basis rows
+        so acks already in flight cannot resurrect a lease for a
+        leadership this group no longer holds."""
+        if not self.lease_cfg.enabled:
+            return
+        gid = g.gid
+        had = self._lease_expiry[gid] > self.clock.monotonic()
+        self._lease_expiry[gid] = 0.0
+        self._lease_sent[gid, :] = 0.0
+        self._lease_basis[gid, :] = 0.0
+        self._lease_dirty.discard(gid)
+        if had:
+            self.counters.incr("read_lease_revocations")
+            self._obs_rec.record(
+                "lease_lost", node=self.name, group=g.name, term=g.term,
+                detail=why,
+            )
+
+    def _stickiness_lapsed(self, g: GroupHost, now: float) -> bool:
+        """False while this replica's promise to its current leader
+        still stands: (pre-)votes for OTHER candidates are disregarded
+        for one election timeout after the last leader contact."""
+        if g.role == C.R_LEADER:
+            return False
+        if g.leader_slot < 0:
+            return True
+        return now - g.lease_contact >= self.election_timeout_s
+
+    def _read_staleness(self, g: GroupHost) -> float:
+        """Upper bound on this replica's staleness vs the leader's
+        wall clock (inf until a leader stamp has been applied)."""
+        if g.fresh_ts <= 0.0:
+            return float("inf")
+        return max(0.0, self.clock.time() - g.fresh_ts) \
+            + self.lease_cfg.drift_epsilon_s
+
+    def _staleness_hist(self):
+        if self._stale_h is None:
+            from ra_tpu import obs as _obs
+
+            self._stale_h = _obs.staleness_hist(self.name)
+        return self._stale_h
+
+    def _lease_applied(self, g: GroupHost, hi: int) -> None:
+        """Freshness-floor upkeep after apply reached ``hi``: leaders
+        stamp their own wall clock once fully caught up; followers
+        promote a pending (leader_commit, commit_ts) anchor whose
+        commit point is now applied."""
+        if g.role == C.R_LEADER:
+            # host mirror: applied == committed, so the leader is
+            # always fully caught up here
+            g.fresh_ts = self.clock.time()
+            return
+        anchor_idx, anchor_ts = g.fresh_anchor
+        if anchor_ts > 0.0 and anchor_idx <= hi:
+            if anchor_ts > g.fresh_ts:
+                g.fresh_ts = anchor_ts
+            g.fresh_anchor = (0, 0.0)
+
+    def _broadcast_vote_req(self, g: GroupHost, queue_send, pre: bool,
+                            force: bool = False) -> None:
         li, lt = g.log.last_index_term()
         sid = (g.name, self.name)
         if pre:
@@ -3318,7 +3543,8 @@ class BatchCoordinator:
             )
         else:
             rpc = RequestVoteRpc(
-                term=g.term, candidate_id=sid, last_log_index=li, last_log_term=lt
+                term=g.term, candidate_id=sid, last_log_index=li,
+                last_log_term=lt, force=force,
             )
         for s, member in enumerate(g.members):
             if s != g.self_slot and member is not None:
@@ -3340,6 +3566,13 @@ class BatchCoordinator:
             li, _ = g.log.last_index_term()
             commit = g.last_applied  # host mirror of commit (applied == committed here)
             sid = (g.name, self.name)
+            # lease (§20): every AER is a quorum-bearing send — stamp
+            # the oldest outstanding send per peer, and carry the wall
+            # clock the commit point was current at (follower
+            # freshness). 0.0 when lease-off: receivers then never
+            # advance their freshness floor.
+            lease_on = self.lease_cfg.enabled
+            cts = self.clock.time() if lease_on else 0.0
             # peers at the same next_index (the steady-state pipeline)
             # share ONE immutable rpc: one entry fetch, one object
             rpc_cache: Dict[int, Any] = {}
@@ -3379,6 +3612,8 @@ class BatchCoordinator:
                         ):
                             self._start_snapshot_sender(g, member)
                             continue
+                        if lease_on:
+                            self._lease_stamp_send(gid, s, now)
                         outbound.setdefault(member[1], []).append((
                             member,
                             AppendEntriesRpc(
@@ -3386,6 +3621,7 @@ class BatchCoordinator:
                                 prev_log_index=prev_idx,
                                 prev_log_term=prev_term,
                                 leader_commit=commit, entries=(),
+                                commit_ts=cts,
                             ),
                             sid,
                         ))
@@ -3404,7 +3640,7 @@ class BatchCoordinator:
                             prev_log_term=prev_f if k == 0 else term_f,
                             leader_commit=commit,
                             entries=tuple(ents_f[k:k + self.aer_batch_size]),
-                            plain_usr=True,
+                            plain_usr=True, commit_ts=cts,
                         )
                         rpc_cache[nxt] = rpc
                 if rpc is None:
@@ -3441,12 +3677,15 @@ class BatchCoordinator:
                             term=g.term, leader_id=sid, prev_log_index=prev_idx,
                             prev_log_term=prev_term, leader_commit=commit,
                             entries=tuple(entries), plain_usr=plain,
+                            commit_ts=cts,
                         )
                     rpc_cache[nxt] = rpc
                 if rpc is self._NEEDS_SNAPSHOT:
                     # peer is behind our compacted floor: stream a snapshot
                     self._start_snapshot_sender(g, member)
                     continue
+                if lease_on:
+                    self._lease_stamp_send(gid, s, now)
                 outbound.setdefault(member[1], []).append((member, rpc, sid))
                 if rpc.entries:
                     g.next_index[s] = rpc.entries[-1].index + 1
@@ -3476,6 +3715,13 @@ class BatchCoordinator:
                 return
             if g.voter_status.get(g.self_slot) != "voter":
                 return  # nonvoters never start elections
+            if self.lease_cfg.enabled and not self._stickiness_lapsed(
+                g, self.clock.monotonic()
+            ):
+                # standing is stickiness-gated too (§20): a candidate
+                # grants its own vote, and could be the one quorum-
+                # intersection voter a live leader's lease counts on
+                return
             self._obs_rec.record(
                 "election", node=self.name, group=g.name, term=g.term,
                 detail="pre_vote round started",
@@ -3502,7 +3748,23 @@ class BatchCoordinator:
                     self._send_batch(node_name, msgs)
             return
         if isinstance(msg, tuple) and msg and msg[0] == "local_query":
-            _, fn, fut = msg
+            # ("local_query", fn, fut) or a 4-tuple carrying the
+            # caller's max_staleness_s bound (docs/INTERNALS.md §20):
+            # the bounded form only answers when the leader-stamped
+            # freshness floor proves local state is recent enough
+            fn, fut = msg[1], msg[2]
+            if len(msg) > 3 and msg[3] is not None:
+                staleness = self._read_staleness(g)
+                self._staleness_hist().record_seconds(
+                    min(staleness, 3600.0)
+                )
+                if staleness > msg[3]:
+                    self.counters.incr("read_stale_rejected")
+                    self._reply(
+                        fut, ("stale", staleness, g.sid_of(g.leader_slot))
+                    )
+                    return
+                self.counters.incr("read_local_bounded")
             self._reply(fut, ("ok", fn(g.machine_state), g.sid_of(g.leader_slot)))
             return
         if isinstance(msg, TimeoutNow):
@@ -3534,7 +3796,10 @@ class BatchCoordinator:
             def queue_send2(to, m, frm):
                 outbound2.setdefault(to[1], []).append((to, m, frm))
 
-            self._broadcast_vote_req(g, queue_send2, pre=False)
+            # forced candidacy (§20): the transferring leader revoked
+            # its lease before sending TimeoutNow, so voters may skip
+            # stickiness for this request
+            self._broadcast_vote_req(g, queue_send2, pre=False, force=True)
             if rare_out is None:
                 for node_name, msgs in outbound2.items():
                     self._send_batch(node_name, msgs)
@@ -3567,6 +3832,10 @@ class BatchCoordinator:
                 self._reply(fut, ("error", "not_up_to_date"))
                 return
             self._reply(fut, ("ok", None))
+            # revoke BEFORE the transfer trigger leaves this node: the
+            # target's forced (stickiness-bypassing) election is only
+            # safe because no lease-holding leader remains (§20)
+            self._lease_revoke(g, "leadership transfer")
             self._send_batch(target[1], [(target, TimeoutNow(), me)])
             return
         if isinstance(msg, tuple) and msg and msg[0] == "lane_recover":
@@ -3621,6 +3890,8 @@ class BatchCoordinator:
             if from_sid is not None:
                 if msg.term >= g.term:
                     g.last_contact = self.clock.monotonic()
+                    if self.lease_cfg.enabled:
+                        g.lease_contact = g.last_contact
                     if msg.term > g.term or g.role != C.R_FOLLOWER:
                         self._adopt_term(g, msg.term, leader_sid=from_sid)
                     elif g.leader_slot < 0:
@@ -3648,6 +3919,7 @@ class BatchCoordinator:
             # durable 'replace' marker is appended (meaningful when the
             # group's log is persistent), and an election follows.
             me = (g.name, self.name)
+            self._lease_revoke(g, "force_shrink")
             idx = g.log.next_index()
             g.log.append(Entry(index=idx, term=g.term, cmd=Command(
                 kind="ra_cluster_change", data=("replace", ((me, "voter"),)))))
@@ -3786,6 +4058,53 @@ class BatchCoordinator:
             self._reply(fut, ("ok", fn(g.machine_state), me))
             return
         now = self.clock.monotonic()
+        if self.lease_cfg.enabled:
+            # lease fast path (§20): within a quorum-earned lease the
+            # read is served locally at read_index = commit (== applied
+            # on this backend) with zero quorum traffic. Demand-driven
+            # renewal: reads in the back half of the window trigger a
+            # stamped heartbeat round (throttled to one per quarter-
+            # window) so a read-only workload renews at an amortized
+            # one round per window instead of one per read.
+            self._lease_refresh()
+            gid = g.gid
+            exp = self._lease_expiry[gid]
+            if exp > now:
+                self.counters.incr("read_lease_served")
+                self._reply(fut, ("ok", fn(g.machine_state), me))
+                if (
+                    exp - now < self.lease_cfg.window_s / 2.0
+                    and now - self._lease_renew_t[gid]
+                    >= self.lease_cfg.window_s / 4.0
+                ):
+                    self._lease_renew_t[gid] = now
+                    hb0 = HeartbeatRpc(
+                        term=g.term, leader_id=me,
+                        query_index=g.query_seq,
+                    )
+                    ob0: Dict[str, List] = {}
+                    for s0, m0 in enumerate(g.members):
+                        if (
+                            m0 is None or s0 == g.self_slot
+                            or g.voter_status.get(s0) != "voter"
+                        ):
+                            continue
+                        self._lease_stamp_send(gid, s0, now)
+                        ob0.setdefault(m0[1], []).append((m0, hb0, me))
+                    for nn0, msgs0 in ob0.items():
+                        self._send_batch(nn0, msgs0)
+                return
+            if exp > 0.0:
+                # held a lease, lapsed: count the expiry once. Bases
+                # stay — they are still honest promises and the
+                # fallback round's acks re-earn the lease.
+                self.counters.incr("read_lease_expirations")
+                self._obs_rec.record(
+                    "lease_lost", node=self.name, group=g.name,
+                    term=g.term, detail="expired",
+                )
+                self._lease_expiry[gid] = 0.0
+            self.counters.incr("read_quorum_fallback")
         fresh = []
         for q in g.pending_queries:
             if now - q["t"] < 10.0:
@@ -3810,6 +4129,9 @@ class BatchCoordinator:
                 or g.voter_status.get(s) != "voter"
             ):
                 continue  # only voter acks may confirm leadership
+            if self.lease_cfg.enabled:
+                # the fallback round's acks re-earn the lease
+                self._lease_stamp_send(g.gid, s, now)
             outbound.setdefault(member[1], []).append((member, hb, me))
         for node_name, msgs in outbound.items():
             self._send_batch(node_name, msgs)
@@ -3819,6 +4141,7 @@ class BatchCoordinator:
         sites hold the state lock): revert to follower on host AND
         device, persist the term, drop in-flight linearizable reads."""
         if g.role == C.R_LEADER:
+            self._lease_revoke(g, "deposed by higher term")
             for q in g.pending_queries:
                 self._reply(q["fut"], ("redirect", None))
             g.pending_queries = []
@@ -3860,6 +4183,8 @@ class BatchCoordinator:
         slot = g.slot_of(from_sid)
         if slot < 0 or g.voter_status.get(slot) != "voter":
             return
+        if self.lease_cfg.enabled:
+            self._lease_credit(g, slot)
         quorum = self._voter_count(g) // 2 + 1
         me = (g.name, self.name)
         done = []
@@ -3887,6 +4212,8 @@ class BatchCoordinator:
             send_one(InstallSnapshotResult(g.term, li, lt))
             return
         g.last_contact = self.clock.monotonic()
+        if self.lease_cfg.enabled:
+            g.lease_contact = g.last_contact
         if msg.chunk_phase == CHUNK_INIT:
             # INIT always starts a fresh accumulator — a retried transfer
             # at the same index must not append onto stale chunks. Chunk

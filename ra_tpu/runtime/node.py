@@ -188,7 +188,19 @@ class RaNode:
     # ?MUTABLE_CONFIG_KEYS, src/ra_server_sup_sup.erl:12-21)
     MUTABLE_CONFIG_KEYS = frozenset(
         {"machine_config", "max_pipeline_count", "max_aer_batch_size",
-         "max_command_backlog", "machine_upgrade_strategy"}
+         "max_command_backlog", "machine_upgrade_strategy",
+         "lease", "lease_safety_factor", "lease_drift_epsilon_s"}
+    )
+
+    # _extra_cfg keys re-extracted from the persisted __server_config__
+    # blob on restart/recovery — a key missing here silently reverts to
+    # its default after a crash (the lease knobs MUST survive restarts:
+    # a harness-restarted server running lease-off would skew safety
+    # and bench runs)
+    _PERSISTED_EXTRA_KEYS = (
+        "max_pipeline_count", "max_aer_batch_size", "max_command_backlog",
+        "machine_upgrade_strategy", "lease", "lease_safety_factor",
+        "lease_drift_epsilon_s",
     )
 
     def start_server(
@@ -275,6 +287,18 @@ class RaNode:
                     max(6 * self.election_timeout_s,
                         10 * self.tick_interval_s),
                 ),
+                # clock-bound leader lease (docs/INTERNALS.md §20):
+                # default off; the follower promise window is the
+                # node's election timeout BASE (timers randomize
+                # upward only), and the core shares the node clock so
+                # the sim/test planes can skew every lease comparison
+                clock=self.clock,
+                election_timeout_s=self.election_timeout_s,
+                lease=extra.get("lease", False),
+                lease_safety_factor=extra.get("lease_safety_factor", 0.8),
+                lease_drift_epsilon_s=extra.get(
+                    "lease_drift_epsilon_s", 0.002
+                ),
             )
             server = Server(cfg, log, self.meta)
             server.recover()
@@ -315,10 +339,7 @@ class RaNode:
             machine_config=rec.get("machine_config"),
             machine_factory=rec.get("machine_factory"),
             _extra_cfg={
-                k: rec[k]
-                for k in ("max_pipeline_count", "max_aer_batch_size",
-                          "max_command_backlog", "machine_upgrade_strategy")
-                if k in rec
+                k: rec[k] for k in self._PERSISTED_EXTRA_KEYS if k in rec
             },
         )
 
@@ -512,10 +533,7 @@ class RaNode:
                     machine_factory=rec.get("machine_factory"),
                     _extra_cfg={
                         k: rec[k]
-                        for k in ("max_pipeline_count", "max_aer_batch_size",
-                                  "max_command_backlog",
-                                  "machine_upgrade_strategy")
-                        if k in rec
+                        for k in self._PERSISTED_EXTRA_KEYS if k in rec
                     },
                 )
             except Exception:  # noqa: BLE001 — one bad server must not

@@ -239,6 +239,7 @@ class ServerProc:
         from collections import deque as _deque
 
         self._low_q = _deque()
+        self._stale_h = None  # lazy follower_read_staleness histogram
         self.running = True
         self._set_tick_timer()
         # a server that starts without evidence of a LIVE leader must arm
@@ -382,10 +383,28 @@ class ServerProc:
                 return server.handle(("consistent_query", fn, fut))
             self._reply(fut, ("redirect", server.leader_id))
             return []
-        _, fn, fut = msg
         if kind == "local_query":
+            # ("local_query", fn, fut) or a 4-tuple carrying the
+            # caller's max_staleness_s bound: the bounded form only
+            # answers when the leader-stamped freshness floor proves
+            # local state is recent enough (docs/INTERNALS.md §20);
+            # otherwise ("stale", bound, leader_hint) so the caller can
+            # retry against the leader
+            fn, fut = msg[1], msg[2]
+            if len(msg) > 3 and msg[3] is not None:
+                staleness = server.read_staleness_s()
+                self._staleness_hist().record_seconds(
+                    min(staleness, 3600.0)
+                )
+                if staleness > msg[3]:
+                    server._c("read_stale_rejected")
+                    self._reply(fut, ("stale", staleness, server.leader_id))
+                    return []
+                server._c("read_local_bounded")
             self._reply(fut, ("ok", fn(server.machine_state), server.leader_id))
-        elif kind == "state_query":
+            return []
+        _, fn, fut = msg
+        if kind == "state_query":
             self._reply(fut, ("ok", fn(server), server.leader_id))
         elif kind == "leader_query":
             if server.role == LEADER:
@@ -393,6 +412,13 @@ class ServerProc:
             else:
                 self._reply(fut, ("redirect", server.leader_id))
         return []
+
+    def _staleness_hist(self):
+        if self._stale_h is None:
+            from ra_tpu import obs as _obs
+
+            self._stale_h = _obs.staleness_hist(self.server.id[1])
+        return self._stale_h
 
     def _handle_sender_event(self, msg) -> List[fx.Effect]:
         if msg[0] == "snapshot_send_done":

@@ -234,6 +234,22 @@ class ServerConfig:
     # read (check-quorum windows, peer-contact stamps) goes through it;
     # None = the real wall clock. The sim plane injects a VirtualClock.
     clock: Optional[Any] = None
+    # clock-bound leader lease (docs/INTERNALS.md §20). OFF by default:
+    # leader stickiness changes election behavior (a follower with
+    # recent leader contact disregards (pre-)votes), which existing
+    # churn tests trigger at will; kv_harness/bench/sim opt in
+    # explicitly. Requires pre_vote — stickiness on the pre-vote round
+    # is what makes the quorum-intersection safety argument hold for
+    # ordinary (non-forced) elections.
+    lease: bool = False
+    # the follower promise window: minimum leader silence before a
+    # follower will help elect a replacement. Must equal the BASE of
+    # the randomized election timer (runtime/timers.py randomizes
+    # upward only), so the promise is never shorter than the lease
+    # math assumes.
+    election_timeout_s: float = 0.15
+    lease_safety_factor: float = 0.8
+    lease_drift_epsilon_s: float = 0.002
 
 
 class Server:
@@ -288,6 +304,43 @@ class Server:
         # contact — AER replies, heartbeat replies, snapshot results,
         # votes); evaluated against cfg.check_quorum_window_s per tick
         self._peer_contact: Dict[ServerId, float] = {}
+
+        # clock-bound leader lease (§20). All lease state lives on the
+        # core (not the proc shell) so the sim plane, which drives
+        # Server directly, exercises every path.
+        if cfg.lease and not cfg.pre_vote:
+            raise ValueError(
+                "lease requires pre_vote: leader stickiness rides the "
+                "pre-vote round (docs/INTERNALS.md §20)"
+            )
+        from ra_tpu.lease import LeaseConfig, LeaseTracker
+
+        self._lease = LeaseTracker(LeaseConfig(
+            enabled=cfg.lease,
+            election_timeout_s=cfg.election_timeout_s,
+            safety_factor=cfg.lease_safety_factor,
+            drift_epsilon_s=cfg.lease_drift_epsilon_s,
+        ))
+        self._lease_renew_t = 0.0  # last demand-driven renewal round
+        # follower side: monotonic stamp of last contact from a live
+        # leader — the stickiness promise is measured against it
+        self._leader_contact = 0.0
+        # TimeoutNow/force_shrink candidacies send force=True votes that
+        # bypass stickiness (the old leader revoked its lease first)
+        self._forced_candidacy = False
+        # lease-admitted reads waiting for applied >= read_index:
+        # (read_index, from_ref, fn) — drained in _apply_to, answered
+        # "redirect" if leadership is lost first (see _become)
+        self.pending_lease_reads: List[Tuple[int, Any, Callable]] = []
+        # True once commit_index provably includes an entry of the
+        # current term (Raft read-index precondition; set by
+        # _evaluate_quorum's current-term gate)
+        self._term_commit_ok = False
+        # staleness-bounded local reads: newest not-yet-applied
+        # (commit_index, leader wall ts) anchor + the applied freshness
+        # floor (read_staleness_s)
+        self._fresh_anchor: Tuple[int, float] = (0, 0.0)
+        self._fresh_ts = 0.0
 
         # consistent-query state (leader side)
         self.query_index: int = 0
@@ -353,6 +406,13 @@ class Server:
             self.counter.put(field, v)
 
     def _set_cluster(self, cluster: Dict[ServerId, PeerState], idx: int, term: int) -> None:
+        if self.role == LEADER and self._lease.cfg.enabled:
+            # the quorum-intersection safety argument holds only for
+            # the voter set the ack bases were collected against: ANY
+            # membership adoption drops the lease (the next read's
+            # renewal round rebuilds it against the new set)
+            if self._lease.revoke():
+                self._c("read_lease_revocations")
         self.cluster = cluster
         self.cluster_index_term = (idx, term)
         if self.id not in self.cluster:
@@ -551,6 +611,8 @@ class Server:
         self._set_cluster({self.id: PeerState()}, idx, self.current_term)
         self.log.append(Entry(index=idx, term=self.current_term, cmd=cmd))
         self.cluster_change_permitted = False
+        # disaster recovery must not stall on stickiness windows
+        self._forced_candidacy = True
         self._call_for_election(effects)
         if from_ref is not None:
             effects.append(Reply(from_ref, ("ok", None)))
@@ -575,6 +637,27 @@ class Server:
             # leadership: replies for commands that still commit are
             # retained until the hold resolves to a real step-down
             self._held_from_leader = True
+        if prev == LEADER and role != LEADER:
+            # leaving leadership in ANY direction — including a hold
+            # that may later resume: a transfer target can win a
+            # TimeoutNow election that (by design) bypasses stickiness,
+            # so the lease dies NOW, held reads redirect immediately,
+            # and in-flight acks must not resurrect the old window
+            # (LeaseTracker.revoke clears the stamps too)
+            if self._lease.revoke():
+                self._c("read_lease_revocations")
+                self._obs_rec.record(
+                    "lease_lost", node=self.id[1], group=self.id[0],
+                    term=self.current_term, detail=f"left leader for {role}",
+                )
+            self._term_commit_ok = False
+            if self.pending_lease_reads:
+                lhint = self.leader_id if self.leader_id != self.id else None
+                for _ri, ref, _fn in self.pending_lease_reads:
+                    effects.append(Reply(ref, ("redirect", lhint)))
+                self.pending_lease_reads = []
+        if role in (FOLLOWER, LEADER):
+            self._forced_candidacy = False
         stepping_down = (prev == LEADER and role not in (LEADER, AWAIT_CONDITION)) or (
             prev == AWAIT_CONDITION
             and role != LEADER
@@ -631,6 +714,11 @@ class Server:
         self.pending_queries = []
         for p in self.cluster.values():
             p.query_index = 0
+        # fresh leadership starts bare: no lease (earned by the first
+        # quorum of acks), no read-index proof until our noop commits
+        self._lease.revoke()
+        self._lease_renew_t = 0.0
+        self._term_commit_ok = False
         self._become(LEADER, effects)
         effects.append(
             RecordLeader(self.cfg.cluster_name, self.id, tuple(self.members()))
@@ -738,6 +826,7 @@ class Server:
         if isinstance(msg, HeartbeatReply):
             peer = self.cluster.get(from_peer)
             if peer is not None and msg.term == self.current_term:
+                self._lease_credit(from_peer)
                 peer.query_index = max(peer.query_index, msg.query_index)
                 self._evaluate_queries(effects)
             elif msg.term > self.current_term:
@@ -871,6 +960,10 @@ class Server:
         peer = self.cluster.get(from_peer)
         if peer is None or msg.term < self.current_term:
             return effects
+        # any same-term reply — success or rejection — proves the
+        # follower processed an AER of ours at this term (its election
+        # timer reset), so it credits the lease basis
+        self._lease_credit(from_peer)
         if msg.success:
             peer.match_index = max(peer.match_index, msg.last_index)
             peer.next_index = max(peer.next_index, msg.last_index + 1)
@@ -922,6 +1015,9 @@ class Server:
             # dec.new_commit_index, with the sort done once
             if self.log.fetch_term(agreed) == self.current_term:
                 self.commit_index = agreed
+                # read-index precondition met: commit_index now covers
+                # an entry of our own term (the noop at the latest)
+                self._term_commit_ok = True
                 if (
                     lat is not None and lat[3] and lat[4] == 0
                     and agreed >= lat[0]
@@ -947,6 +1043,69 @@ class Server:
             else:
                 still.append((qi, from_ref, fn))
         self.pending_queries = still
+
+    # ------------------------------------------------------------------
+    # clock-bound leader lease (docs/INTERNALS.md §20)
+
+    def _lease_credit(self, from_peer: Optional[ServerId]) -> None:
+        """Fold a same-term response from ``from_peer`` into the lease
+        (no-op when leases are off or the response is unsolicited)."""
+        lt = self._lease
+        if not lt.cfg.enabled or from_peer is None:
+            return
+        if not lt.record_ack(from_peer):
+            return
+        now = self._clock.monotonic()
+        had = lt.valid(now)
+        if lt.refresh(self.voters(), self.id, now) and not had and lt.valid(now):
+            self._obs_rec.record(
+                "lease_acquired", node=self.id[1], group=self.id[0],
+                term=self.current_term,
+                detail=f"expires in {lt.remaining(now):.3f}s",
+            )
+
+    def _lease_renewal_round(self, now: float, effects: EffectList) -> None:
+        """One throttled heartbeat fan-out whose acks extend the lease.
+        There are no idle leader heartbeats in this design, so renewal
+        is DEMAND-DRIVEN: reads landing in the back half of the window
+        fund the quorum round that extends it — one round per lease
+        window amortized over every read inside it. No pending query
+        rides on the round; at most one per quarter-window."""
+        lt = self._lease
+        if now - self._lease_renew_t < lt.cfg.window_s / 4.0:
+            return
+        self._lease_renew_t = now
+        hb = HeartbeatRpc(self.current_term, self.id, self.query_index)
+        for sid, p in self.peers().items():
+            if p.is_voter():
+                lt.record_send(sid, now)
+                effects.append(SendRpc(sid, hb))
+
+    def _stickiness_lapsed(self) -> bool:
+        """False while the leader-stickiness promise window holds: a
+        live leader heard within one election timeout (leaders count
+        themselves as in perpetual contact). Callers gate on cfg.lease."""
+        if self.leader_id is None:
+            return True
+        if self.role == LEADER:
+            return False
+        return (
+            self._clock.monotonic() - self._leader_contact
+            >= self.cfg.election_timeout_s
+        )
+
+    def read_staleness_s(self) -> float:
+        """Upper bound on how stale a local read of ``machine_state``
+        is, in seconds of leader wall-clock time (staleness-bounded
+        follower reads). inf until a leader-stamped freshness anchor
+        has been applied — lease-off senders never stamp one, so
+        bounded reads stay conservative there by construction."""
+        if self._fresh_ts <= 0.0:
+            return float("inf")
+        return (
+            max(0.0, self._clock.time() - self._fresh_ts)
+            + self._lease.cfg.drift_epsilon_s
+        )
 
     def _leader_control(self, msg: tuple, effects: EffectList) -> EffectList:
         kind = msg[0]
@@ -980,11 +1139,46 @@ class Server:
             return effects
         if kind == "consistent_query":
             _, fn, from_ref = msg
+            lt = self._lease
+            if lt.cfg.enabled:
+                now = self._clock.monotonic()
+                if self._term_commit_ok and lt.valid(now):
+                    # lease fast path (§20): linearizable at
+                    # read_index = commit_index with ZERO quorum
+                    # traffic — the lease quorum's stickiness promise
+                    # stands in for the heartbeat round
+                    read_idx = self.commit_index
+                    if self.last_applied >= read_idx:
+                        self._c("read_lease_served")
+                        self._c("consistent_queries")
+                        effects.append(
+                            Reply(from_ref, ("ok", fn(self.machine_state), self.id))
+                        )
+                    else:
+                        self.pending_lease_reads.append((read_idx, from_ref, fn))
+                    if lt.remaining(now) < lt.cfg.window_s / 2.0:
+                        self._lease_renewal_round(now, effects)
+                    return effects
+                if lt.expiry > 0.0:
+                    # count each lapse once, at detection
+                    self._c("read_lease_expirations")
+                    self._obs_rec.record(
+                        "lease_lost", node=self.id[1], group=self.id[0],
+                        term=self.current_term, detail="expired",
+                    )
+                    lt.expiry = 0.0
+                self._c("read_quorum_fallback")
             self.query_index += 1
             self.pending_queries.append((self.query_index, from_ref, fn))
             hb = HeartbeatRpc(self.current_term, self.id, self.query_index)
+            if lt.cfg.enabled:
+                now = self._clock.monotonic()
             for sid, p in self.peers().items():
                 if p.is_voter():
+                    if lt.cfg.enabled:
+                        # the fallback round's own acks re-earn the
+                        # lease: subsequent reads go local again
+                        lt.record_send(sid, now)
                     effects.append(SendRpc(sid, hb))
             self._evaluate_queries(effects)  # single-node clusters
             return effects
@@ -1232,6 +1426,14 @@ class Server:
                 acc: List[Entry] = []
                 self.log.fold(prev_idx + 1, hi, lambda e, a: (a.append(e), a)[1], acc)
                 entries = tuple(acc)
+        commit_ts = 0.0
+        if self._lease.cfg.enabled:
+            # lease basis stamp (oldest outstanding send wins) + the
+            # wall-clock freshness stamp followers anchor bounded local
+            # reads to; both gated on cfg.lease so the default path
+            # pays no clock reads
+            self._lease.record_send(sid, self._clock.monotonic())
+            commit_ts = self._clock.time()
         rpc = AppendEntriesRpc(
             term=self.current_term,
             leader_id=self.id,
@@ -1239,6 +1441,7 @@ class Server:
             prev_log_term=prev_term,
             leader_commit=self.commit_index,
             entries=entries,
+            commit_ts=commit_ts,
         )
         effects.append(SendRpc(sid, rpc))
         self._c("msgs_sent")
@@ -1274,6 +1477,28 @@ class Server:
         # rejected clients (one attribute check when none are parked)
         self._adm_gate.open()
         self._c("applied", hi - lo + 1)
+        if self.pending_lease_reads and not discard_effects:
+            # lease-admitted reads whose read_index is now applied:
+            # linearizable as of admission time (state at >= read_index)
+            still_reads = []
+            for ridx, ref, fn in self.pending_lease_reads:
+                if ridx <= hi:
+                    self._c("read_lease_served")
+                    self._c("consistent_queries")
+                    sink.append(Reply(ref, ("ok", fn(self.machine_state), self.id)))
+                else:
+                    still_reads.append((ridx, ref, fn))
+            self.pending_lease_reads = still_reads
+        if self._lease.cfg.enabled:
+            # freshness floor for staleness-bounded local reads: a
+            # leader fully caught up to its commit is fresh as of now;
+            # a follower promotes the leader-stamped anchor once the
+            # anchored index is applied
+            if self.role == LEADER and hi >= self.commit_index:
+                self._fresh_ts = self._clock.time()
+            elif self._fresh_anchor[1] > 0.0 and self._fresh_anchor[0] <= hi:
+                self._fresh_ts = max(self._fresh_ts, self._fresh_anchor[1])
+                self._fresh_anchor = (0, 0.0)
         if not discard_effects:
             for who, corrs in notify.items():
                 sink.append(Notify(who, tuple(corrs)))
@@ -1474,6 +1699,8 @@ class Server:
             if msg.term >= self.current_term:
                 self._update_term(msg.term)
                 self.leader_id = msg.leader_id
+                if self.cfg.lease:
+                    self._leader_contact = self._clock.monotonic()
                 effects.append(
                     SendRpc(from_peer, HeartbeatReply(self.current_term, msg.query_index))
                 )
@@ -1493,6 +1720,10 @@ class Server:
         if isinstance(msg, TimeoutNow):
             if self.is_voter_self():
                 self._c("force_elections")
+                # transfer-driven candidacy: votes carry force=True so
+                # peers skip stickiness (the transferring leader
+                # revoked its lease before sending TimeoutNow)
+                self._forced_candidacy = True
                 self._call_for_election(effects)
             return effects
         if isinstance(msg, Tick):
@@ -1539,6 +1770,18 @@ class Server:
             )
             return effects
         self._update_term(msg.term)
+        if self.cfg.lease:
+            # stickiness stamp: any same-or-higher-term AER is leader
+            # contact (the stale case returned above)
+            self._leader_contact = self._clock.monotonic()
+            if msg.commit_ts > self._fresh_anchor[1]:
+                # freshness anchor: at leader wall time commit_ts the
+                # commit index was >= leader_commit; the local floor
+                # advances once apply catches up (read_staleness_s)
+                if self.last_applied >= msg.leader_commit:
+                    self._fresh_ts = max(self._fresh_ts, msg.commit_ts)
+                else:
+                    self._fresh_anchor = (msg.leader_commit, msg.commit_ts)
         if self.leader_id != msg.leader_id:
             self.leader_id = msg.leader_id
             # acks to a NEW leader may only cover what it has confirmed
@@ -1681,6 +1924,22 @@ class Server:
     def _follower_request_vote(
         self, msg: RequestVoteRpc, from_peer: Optional[ServerId], effects: EffectList
     ) -> EffectList:
+        if (
+            self.cfg.lease
+            and not msg.force
+            and msg.candidate_id != self.leader_id
+            and not self._stickiness_lapsed()
+        ):
+            # leader stickiness (§20 / Raft §9.6): within one election
+            # timeout of leader contact the RPC is DISREGARDED entirely
+            # — answering false at OUR term is fine, but adopting the
+            # higher term would depose the live leader through the term
+            # echo. Forced votes (leadership transfer / force_shrink —
+            # the old leader revoked its lease first) bypass.
+            effects.append(
+                SendRpc(from_peer, RequestVoteResult(self.current_term, False))
+            )
+            return effects
         li, lt = self.log.last_index_term()
         voted_slot = -1
         if self.voted_for is not None and msg.term == self.current_term:
@@ -1724,6 +1983,8 @@ class Server:
             return effects
         self._update_term(msg.term)
         self.leader_id = msg.leader_id
+        if self.cfg.lease:
+            self._leader_contact = self._clock.monotonic()
         self._snap_accept = {
             "meta": msg.meta,
             "chunks": [],
@@ -1757,6 +2018,16 @@ class Server:
             li,
             lt,
         )
+        if (
+            granted
+            and self.cfg.lease
+            and msg.candidate_id != self.leader_id
+            and not self._stickiness_lapsed()
+        ):
+            # leader stickiness (§20): within one election timeout of
+            # leader contact this voter refuses to help elect a
+            # replacement — the promise the leader's lease is bound by
+            granted = False
         effects.append(
             SendRpc(from_peer, PreVoteResult(self.current_term, msg.token, granted))
         )
@@ -1765,6 +2036,14 @@ class Server:
     def _call_for_election_or_pre_vote(self, effects: EffectList) -> EffectList:
         if not self.is_voter_self():
             return effects  # nonvoters never start elections
+        if self.cfg.lease and not self._stickiness_lapsed():
+            # stickiness also gates STANDING: a candidate grants itself,
+            # so an early or injected timeout must not let it complete
+            # a (pre-)vote quorum inside some leader's lease window —
+            # the candidate could be the one intersection voter the
+            # safety argument counts on. TimeoutNow bypasses via
+            # _call_for_election directly.
+            return effects
         if self.cfg.pre_vote:
             return self._call_for_pre_vote(effects)
         return self._call_for_election(effects)
@@ -1810,7 +2089,8 @@ class Server:
             return effects
         li, lt = self.log.last_index_term()
         rpc = RequestVoteRpc(
-            term=self.current_term, candidate_id=self.id, last_log_index=li, last_log_term=lt
+            term=self.current_term, candidate_id=self.id, last_log_index=li,
+            last_log_term=lt, force=self._forced_candidacy,
         )
         reqs = tuple((sid, rpc) for sid, p in self.peers().items() if p.is_voter())
         effects.append(SendVoteRequests(reqs))

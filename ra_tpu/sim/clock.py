@@ -50,3 +50,41 @@ class VirtualClock:
         if t_ms < self.now_ms:
             raise ValueError(f"time moved backwards: {t_ms} < {self.now_ms}")
         self.now_ms = t_ms
+
+
+class SkewedClock:
+    """Per-node view over a shared :class:`VirtualClock` running at a
+    slightly different RATE (``1 + rate``, e.g. ``rate=0.01`` is a
+    clock 1% fast).
+
+    Rate skew — not offset — is the honest adversary for clock-bound
+    leases (docs/INTERNALS.md §20): a constant offset cancels out of
+    every lease comparison (basis vs now on the leader's own clock,
+    contact vs now on the follower's own clock), while rate skew makes
+    one node's measured election-timeout window genuinely shorter or
+    longer than another's. The lease ``drift_epsilon_s`` exists to
+    absorb exactly this, so the sim draws each node's rate from the
+    schedule seed (bounded by ``Schedule.skew_ppm``) and the lease
+    config widens epsilon to cover the bound — a run that violates
+    linearizability under covered skew is a real lease-math bug."""
+
+    __slots__ = ("_base", "rate")
+
+    def __init__(self, base: VirtualClock, rate: float) -> None:
+        self._base = base
+        self.rate = rate
+
+    def monotonic(self) -> float:
+        return (self._base.now_ms / 1000.0) * (1.0 + self.rate)
+
+    def monotonic_ns(self) -> int:
+        return int(self._base.now_ms * 1_000_000 * (1.0 + self.rate))
+
+    def time(self) -> float:
+        return SIM_EPOCH_S + (self._base.now_ms / 1000.0) * (1.0 + self.rate)
+
+    def sleep(self, seconds: float) -> None:
+        raise RuntimeError(
+            "sleep() on the virtual clock: simulated code must schedule "
+            "an event (SimScheduler.after_ms), never block a thread"
+        )

@@ -51,8 +51,13 @@ def explore(
     failures: List[Dict[str, Any]] = []
     for workload in workloads:
         for seed in seeds:
+            # kvread is the lease read-safety workload: leases on and
+            # per-node clock rate skew at the covered bound, so the
+            # sweep probes the drift-epsilon math, not a lease-off path
+            lease_kw = (dict(lease=True, skew_ppm=10_000)
+                        if workload == "kvread" else {})
             sched = Schedule(seed=seed, workload=workload, n_ops=n_ops,
-                             nemesis=nemesis, **fa)
+                             nemesis=nemesis, **fa, **lease_kw)
             res = run_schedule(sched)
             ran += 1
             steps += res.steps

@@ -21,6 +21,14 @@ Op vocabulary:
   ("cmd", payload)        -- client command to the current leader
   ("down", target)        -- monitored client process dies
   ("nem", op_i)           -- one nemesis planner step (planner rng decides)
+  ("read", target)        -- consistent_query; target is a node index,
+                             "leader" (current), or "old" (the leader
+                             captured by the last isolate op)
+  ("isolate", "leader")   -- block the current leader from everyone,
+                             both directions, and remember it as "old"
+  ("etimo", "other")      -- deterministic ElectionTimeout at the first
+                             running voter that is not the old leader
+  ("unblock",)            -- heal every directed block now
 """
 
 from __future__ import annotations
@@ -69,6 +77,12 @@ class Schedule:
     delay_p: float = 0.0
     delay_ms_max: int = 40
     nemesis: bool = False
+    # clock-bound leader leases (docs/INTERNALS.md §20): lease=True
+    # starts every server lease-enabled; skew_ppm bounds the per-node
+    # clock RATE skew (parts per million, drawn from the seed) that the
+    # lease drift epsilon is widened to cover
+    lease: bool = False
+    skew_ppm: int = 0
     ops: Optional[Tuple[Op, ...]] = None  # explicit timeline overrides n_ops
 
     def with_ops(self, ops: List[Op]) -> "Schedule":
@@ -91,6 +105,7 @@ def dumps(sched: Schedule) -> str:
         f"horizon_ms={sched.horizon_ms} settle_ms={sched.settle_ms}",
         f"drop_p={sched.drop_p} dup_p={sched.dup_p} delay_p={sched.delay_p}"
         f" delay_ms_max={sched.delay_ms_max} nemesis={sched.nemesis}",
+        f"lease={sched.lease} skew_ppm={sched.skew_ppm}",
     ]
     for t_ms, op in sched.resolve_ops():
         lines.append(f"{t_ms} {op!r}")
@@ -122,5 +137,7 @@ def loads(text: str) -> Schedule:
         delay_p=float(head.get("delay_p", 0.0)),
         delay_ms_max=int(head.get("delay_ms_max", 40)),
         nemesis=head.get("nemesis", "False") == "True",
+        lease=head.get("lease", "False") == "True",
+        skew_ppm=int(head.get("skew_ppm", 0)),
         ops=tuple(ops),
     )

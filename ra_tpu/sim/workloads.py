@@ -37,7 +37,7 @@ from ra_tpu.models.fifo import FifoMachine
 from ra_tpu.models.kv import KvMachine
 from ra_tpu.models.session import SessionMachine
 
-WORKLOADS = ("kv", "fifo", "session")
+WORKLOADS = ("kv", "fifo", "session", "kvread")
 
 _KV_KEYS = 8
 _FIFO_CONSUMERS = ("c0", "c1", "c2")
@@ -49,7 +49,7 @@ def make_machine(workload: str, ctr=None):
     """Machine factory for one replica. ``ctr`` (SESSION_FIELDS) goes to
     exactly one replica's machine — apply runs on every replica, so a
     shared vector would multiply every count by the cluster size."""
-    if workload == "kv":
+    if workload in ("kv", "kvread"):
         return KvMachine(snapshot_interval=24)
     if workload == "fifo":
         return FifoMachine()
@@ -63,6 +63,8 @@ def make_machine(workload: str, ctr=None):
 
 def generate_ops(sched) -> List[Tuple[int, Tuple[Any, ...]]]:
     rng = random.Random((sched.seed << 4) ^ 0x4F5053)  # "OPS"
+    if sched.workload == "kvread":
+        return _gen_kvread_ops(sched, rng)
     gen = {
         "kv": _gen_kv,
         "fifo": _gen_fifo,
@@ -85,6 +87,43 @@ def generate_ops(sched) -> List[Tuple[int, Tuple[Any, ...]]]:
             k += 1
     ops.sort(key=lambda p: p[0])
     return ops
+
+
+def _gen_kvread_ops(sched, rng: random.Random) -> List[Tuple[int, Tuple[Any, ...]]]:
+    """Lease read-safety workload (docs/INTERNALS.md §20): writes to
+    one key interleaved with dense consistent reads fanned across
+    every node. The oracle lives in the world's reply recorder: a
+    write's ack carries its raft index; a read invoked after that ack
+    must observe a "seq" entry at an index >= the acked floor — the
+    linearizability claim the leader lease makes. Reads land on every
+    node (not just the believed leader) precisely so a deposed leader
+    still inside a too-long lease window serves one and gets caught."""
+    ops: List[Tuple[int, Tuple[Any, ...]]] = []
+    t = 0
+    gap = max(2, (2 * sched.horizon_ms) // max(1, sched.n_ops))
+    for _ in range(sched.n_ops):
+        t += 1 + rng.randrange(gap)
+        if t >= sched.horizon_ms:
+            break
+        if rng.random() < 0.45:
+            ops.append((t, ("cmd", ("put", "seq", 0))))  # value unused
+        else:
+            ops.append((t, ("read", rng.randrange(sched.nodes))))
+    if sched.nemesis:
+        k = 0
+        for t in range(300, sched.horizon_ms, 400):
+            ops.append((t, ("nem", k)))
+            k += 1
+    ops.sort(key=lambda p: p[0])
+    return ops
+
+
+def read_seq_index(state) -> int:
+    """The consistent-read probe for the kvread workload: the raft
+    index the "seq" key was last written at (-1 before any write).
+    Module-level so a dumped schedule replays without a closure."""
+    entry = state.get("seq")
+    return entry[0] if entry else -1
 
 
 def _gen_kv(rng: random.Random, i: int) -> Tuple[Any, ...]:
@@ -135,6 +174,7 @@ def _gen_session(rng: random.Random, i: int) -> Tuple[Any, ...]:
 def invariant_for(workload: str) -> Optional[Callable]:
     return {
         "kv": None,
+        "kvread": None,  # read oracle runs in the world's reply recorder
         "fifo": _fifo_invariant,
         "session": _session_invariant,
     }[workload]

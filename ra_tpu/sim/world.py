@@ -71,7 +71,7 @@ from ra_tpu.server import (
     ServerConfig,
     status_kind,
 )
-from ra_tpu.sim.clock import VirtualClock
+from ra_tpu.sim.clock import SkewedClock, VirtualClock
 from ra_tpu.sim.scheduler import SimScheduler
 from ra_tpu.sim.schedule import Schedule
 from ra_tpu.sim.transport import SimNetwork
@@ -141,6 +141,12 @@ class SimNode:
         self.world = world
         self.name = f"n{idx}"
         self.sid: ServerId = ("srv", self.name)
+        # per-node clock view: rate-skewed when the schedule asks for
+        # clock skew (the adversary the lease drift epsilon absorbs)
+        rate = world.clock_rates.get(self.name, 0.0)
+        self.clock = (
+            SkewedClock(world.clock, rate) if rate else world.clock
+        )
         # durable across crash-restarts (the actor backend restarts over
         # its WAL/meta the same way: runtime/node.py restart path)
         self.log = MemoryLog(auto_written=False)
@@ -168,7 +174,16 @@ class SimNode:
             initial_members=w.members,
             counters_enabled=False,
             check_quorum_window_s=w.check_quorum_s,
-            clock=w.clock,
+            clock=self.clock,
+            # clock-bound leases (docs/INTERNALS.md §20): the server's
+            # promise window must equal the sim's election timer base
+            # (arm_election randomizes upward only), and the drift
+            # epsilon is widened to cover the schedule's rate-skew
+            # bound — with that covered, any stale consistent read the
+            # kvread oracle sees is a genuine lease-math violation
+            lease=w.lease,
+            election_timeout_s=w.election_ms / 1000.0,
+            lease_drift_epsilon_s=w.lease_drift_eps_s,
         )
         self.server = Server(cfg, self.log, self.meta)
 
@@ -601,6 +616,24 @@ class SimWorld:
             ("srv", f"n{i}") for i in range(sched_in.nodes)
         )
         self.ctr = ra_counters.registry().new(("sim", "plane"), SIM_FIELDS)
+        # lease plane (docs/INTERNALS.md §20): per-node clock RATE skew
+        # drawn from its own seed stream, bounded by the schedule; the
+        # drift epsilon covers 2x the bound over both promise windows
+        self.lease = sched_in.lease
+        skew = sched_in.skew_ppm * 1e-6
+        skew_rng = random.Random((sched_in.seed << 3) ^ 0x534B57)  # "SKW"
+        self.clock_rates = {
+            f"n{i}": (skew_rng.uniform(-skew, skew) if skew else 0.0)
+            for i in range(sched_in.nodes)
+        }
+        self.lease_drift_eps_s = 0.002 + 4.0 * skew * (self.election_ms / 1000.0)
+        # kvread stale-read oracle state: acked write floor (raft index
+        # of the highest acked put), per-read floors at invocation, and
+        # which client refs were seq writes
+        self._acked_floor = -1
+        self._read_floor: Dict[int, int] = {}
+        self._seq_write_refs: Set[int] = set()
+        self._old_leader: Optional[str] = None
         self._session_ctr = (
             ra_counters.registry().new(("session", "sim"), SESSION_FIELDS)
             if sched_in.workload == "session"
@@ -726,8 +759,33 @@ class SimWorld:
                 )
 
     def record_reply(self, from_ref: Any, reply: Any) -> None:
-        if isinstance(from_ref, tuple) and len(from_ref) == 2 and from_ref[0] == "cli":
-            self.replies.setdefault(from_ref[1], []).append(reply)
+        if not (isinstance(from_ref, tuple) and len(from_ref) == 2):
+            return
+        kind, i = from_ref
+        if kind == "cli":
+            self.replies.setdefault(i, []).append(reply)
+            if (i in self._seq_write_refs
+                    and isinstance(reply, tuple) and reply
+                    and reply[0] == "ok"):
+                # KvMachine's put reply carries the applied raft index:
+                # the monotone sequence the read oracle floors against
+                idx = reply[1][1] if isinstance(reply[1], tuple) else -1
+                if idx > self._acked_floor:
+                    self._acked_floor = idx
+        elif kind == "rd":
+            self.replies.setdefault(i, []).append(reply)
+            floor = self._read_floor.pop(i, None)
+            if (floor is None or not isinstance(reply, tuple) or not reply
+                    or reply[0] != "ok"):
+                return  # redirects/timeouts carry no linearizability claim
+            self.trace("readok", self.clock.now_ms, i, reply[1], floor)
+            if reply[1] < floor:
+                # the lease's whole claim: a consistent read invoked
+                # after a write was acked must observe it
+                self.violation(
+                    f"stale consistent read rd/{i}: observed seq index "
+                    f"{reply[1]} < acked floor {floor} at invocation"
+                )
 
     # -- nemesis callbacks ---------------------------------------------------------
 
@@ -761,10 +819,56 @@ class SimWorld:
                         break
             if target is None:
                 return
+            if (isinstance(op[1], tuple) and len(op[1]) >= 2
+                    and op[1][0] == "put" and op[1][1] == "seq"):
+                self._seq_write_refs.add(i)
             self.trace("cmd", t_ms, i, target.name, repr(op[1]))
             target.post(Command(kind=USR, data=op[1],
                                 reply_mode="await_consensus",
                                 from_ref=("cli", i)))
+        elif kind == "read":
+            # consistent read (docs/INTERNALS.md §20). Targets a node
+            # directly — including non-leaders, which drop it — so a
+            # deposed leader still inside its lease window answers and
+            # is held to the acked-write floor captured right here.
+            tgt = op[1]
+            if tgt == "leader":
+                node = self.current_leader()
+            elif tgt == "old":
+                node = self.nodes.get(self._old_leader or "")
+            else:
+                node = self.nodes.get(f"n{int(tgt) % len(self.nodes)}")
+            if node is None or not node.running:
+                return
+            self._op_i += 1
+            i = self._op_i
+            self._read_floor[i] = self._acked_floor
+            self.trace("read", t_ms, i, node.name, self._acked_floor)
+            from ra_tpu.sim.workloads import read_seq_index
+
+            node.post(("consistent_query", read_seq_index, ("rd", i)))
+        elif kind == "isolate" and op[1] == "leader":
+            target = self.current_leader()
+            if target is None:
+                return
+            self._old_leader = target.name
+            for other in self.nodes:
+                if other != target.name:
+                    self.net.block(target.name, other)
+                    self.net.block(other, target.name)
+            self.trace("isolate", t_ms, target.name)
+        elif kind == "etimo":
+            # deterministic election trigger: the first running voter
+            # that is not the old leader campaigns NOW (the server's
+            # own stickiness standing guard still applies)
+            for name in sorted(self.nodes):
+                if name != self._old_leader and self.nodes[name].running:
+                    self.trace("etimo_op", t_ms, name)
+                    self.nodes[name].post(ElectionTimeout())
+                    break
+        elif kind == "unblock":
+            self.trace("unblock", t_ms)
+            self.net.unblock_all()
         elif kind == "down":
             target = op[1]
             watchers = sorted(self.monitors.get(("process", target), ()))

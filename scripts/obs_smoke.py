@@ -177,7 +177,8 @@ def main() -> int:
     # and the one-call system_overview surface
     leaderboard.clear()
     coords = [
-        BatchCoordinator(f"obs{i}", capacity=8, num_peers=3) for i in range(3)
+        BatchCoordinator(f"obs{i}", capacity=8, num_peers=3, lease=True)
+        for i in range(3)
     ]
     for c in coords:
         c.start()
@@ -197,6 +198,29 @@ def main() -> int:
             time.sleep(0.02)
         for _ in range(3):
             api.process_command(("og0", "obs0"), 1)
+        # lease read path (docs/INTERNALS.md §20): the write traffic's
+        # AER acks earned the leader lease — consistent reads must now
+        # serve locally, and a staleness-bounded local read must record
+        # the follower-staleness histogram; both families are gated in
+        # the scrape below
+        deadline = time.time() + 15
+        while (
+            coords[0].counters.get("read_lease_served") < 1
+            and time.time() < deadline
+        ):
+            out = api.consistent_query(("og0", "obs0"), lambda s: s)
+            if out[0] != "ok" or out[1] != 3:
+                errors.append(f"lease-path consistent_query wrong: {out!r}")
+                break
+        if coords[0].counters.get("read_lease_served") < 1:
+            errors.append("consistent reads never served from the lease")
+        try:
+            bout = api.local_query(("og0", "obs0"), lambda s: s,
+                                   max_staleness_s=30.0)
+            if bout[0] != "ok":
+                errors.append(f"bounded local read failed: {bout!r}")
+        except api.StaleReadError as e:
+            errors.append(f"bounded local read rejected on the leader: {e}")
         # at least one health scan per node (tick cadence: 1s default),
         # AND a scan recent enough to have seen the elected leader —
         # rows snapshot the LAST scan, which may predate the election
@@ -341,6 +365,17 @@ def main() -> int:
             r"# TYPE ra_session_lock_releases counter",
             r"# TYPE ra_session_lock_steals counter",
             r"# TYPE ra_session_lock_handoffs counter",
+            # lease-based local reads (docs/INTERNALS.md §20): the
+            # burst above must have served at least one read from the
+            # lease and recorded one bounded local read + its
+            # staleness histogram (per-node family name)
+            r"ra_read_lease_served\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_read_local_bounded\{[^}]*obs0[^}]*\} (\d+)",
+            r"ra_follower_read_staleness_\w+_seconds_count (\d+)",
+            r"# TYPE ra_read_quorum_fallback counter",
+            r"# TYPE ra_read_lease_expirations counter",
+            r"# TYPE ra_read_lease_revocations counter",
+            r"# TYPE ra_read_stale_rejected counter",
         ]
         _check_exposition(text, errors, required_live)
 
@@ -389,6 +424,8 @@ def main() -> int:
             errors.append(f"commit stages never recorded: {sorted(missing)}")
         if not any(e["kind"] == "election" for e in ov["events"]):
             errors.append("flight recorder holds no election event")
+        if not any(e["kind"] == "lease_acquired" for e in ov["events"]):
+            errors.append("flight recorder holds no lease_acquired event")
     finally:
         for c in coords:
             c.stop()

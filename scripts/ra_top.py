@@ -41,21 +41,46 @@ DIMENSIONS = (
 _STATE_ORDER = ("stuck", "flapping", "lagging", "quiet")
 
 
-def render(health: dict, top_k: int = 5) -> str:
-    """Render one cluster_health() snapshot as a plain-text panel."""
+def _reads_total(s: dict) -> int:
+    r = s.get("reads", {})
+    return (r.get("read_lease_served", 0) + r.get("read_quorum_fallback", 0)
+            + r.get("read_local_bounded", 0))
+
+
+def render(health: dict, top_k: int = 5, prev: dict = None,
+           dt: float = None) -> str:
+    """Render one cluster_health() snapshot as a plain-text panel.
+
+    ``prev``/``dt`` (the previous snapshot and the seconds between
+    them) turn the cumulative per-node read totals — lease-served +
+    quorum-fallback consistent reads + bounded local reads,
+    docs/INTERNALS.md §20 — into a reads/s column.
+    """
     lines = []
     nodes = health.get("nodes", {})
     lines.append(f"== ra_top · {len(nodes)} nodes · "
                  f"{sum(n.get('groups', 0) for n in nodes.values())} groups ==")
+    prev_nodes = (prev or {}).get("nodes", {})
     for name, s in sorted(nodes.items()):
         st = s.get("states", {})
         badges = " ".join(
             f"{k}={st.get(k, 0)}" for k in _STATE_ORDER if st.get(k)
         ) or "all quiet"
+        reads = _reads_total(s)
+        if name in prev_nodes and dt:
+            rate = max(0, reads - _reads_total(prev_nodes[name])) / dt
+            reads_col = f"reads/s={rate:<8.1f}"
+        else:
+            reads_col = f"reads={reads:<8d}"
+        lease_pct = ""
+        served = s.get("reads", {}).get("read_lease_served", 0)
+        fallback = s.get("reads", {}).get("read_quorum_fallback", 0)
+        if served + fallback:
+            lease_pct = f"lease%={100.0 * served / (served + fallback):.0f} "
         lines.append(
             f"  {name:<14s} [{s.get('backend', '?'):<15s}] "
             f"groups={s.get('groups', 0):<5d} scans={s.get('scans', 0):<6d} "
-            f"{badges}"
+            f"{reads_col} {lease_pct}{badges}"
         )
     rows = [
         r
@@ -170,6 +195,7 @@ def main() -> int:
         teardown = _demo_cluster()
     try:
         i = 0
+        prev, prev_t = None, None
         while True:
             i += 1
             if args.from_json:
@@ -179,8 +205,11 @@ def main() -> int:
                 from ra_tpu import api
 
                 health = api.cluster_health()
+            now = time.monotonic()
+            dt = (now - prev_t) if prev_t is not None else None
             print(f"\n{time.strftime('%H:%M:%S')}  (refresh {i})")
-            print(render(health, top_k=args.top))
+            print(render(health, top_k=args.top, prev=prev, dt=dt))
+            prev, prev_t = health, now
             sys.stdout.flush()
             if args.iterations and i >= args.iterations:
                 return 0

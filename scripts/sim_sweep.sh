@@ -34,7 +34,7 @@ echo "== sim sweep: pytest lane (SIM_SEED_BASE=$SIM_SEED_BASE) =="
 python -m pytest tests/test_sim.py -q -m sim \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "== sim sweep: explorer, $N fresh seeds x kv/fifo/session =="
+echo "== sim sweep: explorer, $N fresh seeds x kv/fifo/session/kvread =="
 python -m ra_tpu.sim.explorer --seeds "$N" --start "$SIM_SEED_BASE"
 
 echo "sim sweep: PASS (SIM_SEED_BASE=$SIM_SEED_BASE)"

@@ -48,4 +48,31 @@ for seed in $(seq 100 $((99 + N))); do
         done
     done
 done
+
+echo "== soak: lease read dimension ($N fresh seeds per backend) =="
+# linearizable-read dimension (docs/INTERNALS.md §20): leases on,
+# one-way partitions, depositions racing the consistent-read stream
+for seed in $(seq 200 $((199 + N))); do
+    for backend in per_group_actor tpu_batch; do
+        echo "-- seed=$seed backend=$backend lease=on"
+        python -m ra_tpu.kv_harness --lease --seed "$seed" \
+            --ops 100 --backend "$backend" \
+            >/tmp/soak_run.log 2>&1 \
+            || { echo "soak FAILED: seed=$seed backend=$backend lease=on"; \
+                 tail -60 /tmp/soak_run.log; exit 1; }
+    done
+done
+
+echo "== soak: consistent-read bench (lease vs quorum control) =="
+# smoke-scale read bench: the lease arm must beat the quorum-round
+# control — a regression to fallback-on-every-read fails the soak
+python bench.py --reads --smoke > /tmp/soak_reads.json \
+    || { echo "soak FAILED: read bench"; exit 1; }
+python - <<'EOF' || { echo "soak FAILED: lease read speedup regressed"; \
+                      cat /tmp/soak_reads.json; exit 1; }
+import json
+d = json.load(open("/tmp/soak_reads.json"))
+assert d["read_speedup"] >= 2.0, d["read_speedup"]
+assert d["lease_on"]["read_quorum_fallback"] == 0, d["lease_on"]
+EOF
 echo "soak: PASS"

@@ -42,6 +42,7 @@ def make_server(
     auto_written: bool = True,
     meta: Optional[InMemoryMeta] = None,
     log: Optional[MemoryLog] = None,
+    **cfg_kw,
 ) -> Server:
     cfg = ServerConfig(
         server_id=sid,
@@ -50,6 +51,7 @@ def make_server(
         machine=machine,
         initial_members=tuple(members),
         counters_enabled=False,
+        **cfg_kw,
     )
     return Server(cfg, log or MemoryLog(auto_written=auto_written), meta or InMemoryMeta())
 
@@ -160,9 +162,12 @@ class Net:
         self.run()
 
 
-def three_node_net(machine_factory: Callable[[], Any], auto_written: bool = True) -> Net:
+def three_node_net(
+    machine_factory: Callable[[], Any], auto_written: bool = True, **cfg_kw
+) -> Net:
     ids = [("s1", "nodeA"), ("s2", "nodeB"), ("s3", "nodeC")]
     servers = {
-        sid: make_server(sid, ids, machine_factory(), auto_written=auto_written) for sid in ids
+        sid: make_server(sid, ids, machine_factory(), auto_written=auto_written, **cfg_kw)
+        for sid in ids
     }
     return Net(servers, auto_written=auto_written)

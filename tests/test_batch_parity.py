@@ -172,15 +172,19 @@ def test_batch_membership_add_remove_and_promote():
         stop_all(coords)
 
 
-def test_batch_consistent_query():
-    coords = mk_cluster("cq")
+@pytest.mark.parametrize("lease", [False, True], ids=["lease-off", "lease-on"])
+def test_batch_consistent_query(lease):
+    # identical contract either way; lease-on may serve from the (G,)
+    # lease plane with zero quorum traffic (docs/INTERNALS.md §20)
+    pfx = "cql" if lease else "cq"
+    coords = mk_cluster(pfx, lease=lease)
     try:
-        sid = ("cqg0", "cq0")
+        sid = (f"{pfx}g0", f"{pfx}0")
         r, _ = api.process_command(sid, 9, timeout=20)
         out = api.consistent_query(sid, lambda s: s, timeout=20)
         assert out[0] == "ok" and out[1] == 9, out
         # redirect from a follower works too
-        out = api.consistent_query(("cqg0", "cq1"), lambda s: s, timeout=20)
+        out = api.consistent_query((f"{pfx}g0", f"{pfx}1"), lambda s: s, timeout=20)
         assert out[0] == "ok" and out[1] == 9, out
     finally:
         stop_all(coords)

@@ -175,3 +175,37 @@ def test_kv_harness_overload_matrix(backend, seed):
     res = kv_harness.run(seed=seed, n_ops=60, backend=backend, overload=True)
     assert res.consistent, res.failures
     assert res.ops.get("overload_acked", 0) > 0
+
+
+# linearizable-read dimension (docs/INTERNALS.md §20): clock-bound
+# leader leases on, one-way partitions in the nemesis mix, periodic
+# forced depositions via transfer_leadership racing the read stream.
+# Every consistent read is checked against the reference model, so a
+# lease surviving its leader's deposition (or a drift bound too loose
+# for the clock) surfaces as a stale-read failure. One fast seed per
+# backend rides tier-1; the 3-seed acceptance matrix is slow-marked.
+
+
+def test_kv_harness_lease_reads_batch():
+    res = kv_harness.run(seed=61, n_ops=80, backend="tpu_batch",
+                         lease=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("get", 0) > 0
+    assert res.ops.get("transfer", 0) > 0, "no depositions raced the reads"
+
+
+def test_kv_harness_lease_reads_actor():
+    res = kv_harness.run(seed=62, n_ops=80, backend="per_group_actor",
+                         lease=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("get", 0) > 0
+    assert res.ops.get("transfer", 0) > 0, "no depositions raced the reads"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["tpu_batch", "per_group_actor"])
+@pytest.mark.parametrize("seed", [63, 64, 65])
+def test_kv_harness_lease_reads_matrix(backend, seed):
+    res = kv_harness.run(seed=seed, n_ops=100, backend=backend, lease=True)
+    assert res.consistent, res.failures
+    assert res.ops.get("get", 0) > 0

@@ -1,0 +1,148 @@
+"""Unit tests for the pure lease clock math (ra_tpu/lease.py,
+docs/INTERNALS.md §20): quorum extension, minority non-extension,
+drift/safety margins, revocation semantics, and the vectorized batch
+helper. Everything here is clockless — times are plain floats."""
+
+import numpy as np
+import pytest
+
+import ra_tpu.lease as lease_mod
+from ra_tpu.lease import LeaseConfig, LeaseTracker, lease_expiry, quorum_bases
+
+A, B, C, D, E = "a", "b", "c", "d", "e"
+CFG = LeaseConfig(enabled=True, election_timeout_s=1.0,
+                  safety_factor=0.8, drift_epsilon_s=0.01)
+
+
+def test_expiry_formula_margins_shrink_the_window():
+    # expiry = basis + elt*safety - eps, strictly inside the follower
+    # promise window (basis + elt)
+    e = lease_expiry(10.0, 1.0, 0.8, 0.01)
+    assert e == pytest.approx(10.79)
+    assert e < 10.0 + 1.0
+    # drift epsilon strictly shrinks; safety factor scales
+    assert lease_expiry(10.0, 1.0, 0.8, 0.1) < e
+    assert lease_expiry(10.0, 1.0, 0.5, 0.01) < e
+
+
+def test_quorum_ack_extends():
+    t = LeaseTracker(CFG)
+    t.record_send(B, 1.0)
+    t.record_send(C, 1.0)
+    assert t.record_ack(B)
+    # self + b = 2 of 3 voters: quorum basis is the send stamp (1.0),
+    # NOT the (later) evaluation time
+    assert t.refresh([A, B, C], A, now=2.0)
+    assert t.expiry == pytest.approx(CFG.expiry(1.0))
+    assert t.valid(1.5)
+    assert not t.valid(CFG.expiry(1.0))
+
+
+def test_minority_ack_does_not_extend():
+    t = LeaseTracker(CFG)
+    for p in (B, C, D, E):
+        t.record_send(p, 1.0)
+    t.record_ack(B)
+    # self + b = 2 of 5 voters < quorum(3): no lease
+    assert not t.refresh([A, B, C, D, E], A, now=2.0)
+    assert t.expiry == 0.0
+    # one more voter tips it over
+    t.record_ack(C)
+    assert t.refresh([A, B, C, D, E], A, now=2.0)
+    assert t.expiry == pytest.approx(CFG.expiry(1.0))
+
+
+def test_ack_credits_oldest_outstanding_send():
+    t = LeaseTracker(CFG)
+    t.record_send(B, 1.0)
+    t.record_send(B, 5.0)  # second send before any ack: stamp stays 1.0
+    assert t.record_ack(B)
+    t.refresh([A, B, C], A, now=6.0)
+    assert t.expiry == pytest.approx(CFG.expiry(1.0))
+    # after the ack consumed the stamp, a fresh send re-stamps
+    t.record_send(B, 7.0)
+    assert t.record_ack(B)
+    assert t.refresh([A, B, C], A, now=8.0)
+    assert t.expiry == pytest.approx(CFG.expiry(7.0))
+
+
+def test_unsolicited_ack_credits_nothing():
+    t = LeaseTracker(CFG)
+    assert not t.record_ack(B)  # no send on record
+    assert not t.refresh([A, B, C], A, now=2.0)
+    assert t.expiry == 0.0
+
+
+def test_expiry_never_moves_backwards():
+    t = LeaseTracker(CFG)
+    t.record_send(B, 5.0)
+    t.record_ack(B)
+    assert t.refresh([A, B, C], A, now=6.0)
+    high = t.expiry
+    # a later refresh over a WORSE basis (e.g. voter-set growth diluting
+    # the quorum rank) must not pull the horizon back
+    t.record_send(D, 5.5)
+    assert not t.refresh([A, B, C, D, E], A, now=6.0)
+    assert t.expiry == high
+
+
+def test_revocation_clears_expiry_and_stamps():
+    t = LeaseTracker(CFG)
+    t.record_send(B, 1.0)
+    t.record_ack(B)
+    t.refresh([A, B, C], A, now=1.5)
+    t.record_send(C, 1.2)  # outstanding at revocation time
+    assert t.revoke()
+    assert t.expiry == 0.0 and not t.valid(0.0)
+    # the in-flight ack from the pre-revocation send credits nothing:
+    # a deposed leader's stale quorum must not resurrect the lease
+    assert not t.record_ack(C)
+    assert not t.refresh([A, B, C], A, now=2.0)
+    assert t.expiry == 0.0
+    assert not t.revoke()  # already bare
+
+
+def test_planted_drift_bound_bug_overextends(monkeypatch):
+    honest = lease_expiry(10.0, 1.0, 0.8, 0.01)
+    monkeypatch.setattr(lease_mod, "SIM_BUG_DRIFT_BOUND", True)
+    buggy = lease_expiry(10.0, 1.0, 0.8, 0.01)
+    # the broken bound exceeds the follower promise window — exactly
+    # the unsafe regime the sim oracle must catch
+    assert buggy > 10.0 + 1.0 > honest
+
+
+def test_quorum_bases_vectorized():
+    bases = np.array([
+        [9.0, 4.0, 7.0, 0.0],   # 3 voters, quorum 2 -> 2nd largest = 7
+        [9.0, 0.0, 0.0, 0.0],   # 3 voters, quorum 2 -> 2nd largest = 0
+        [5.0, 5.0, 5.0, 5.0],   # 4th col not a voter -> [5,5,5] q2 = 5
+        [1.0, 2.0, 3.0, 4.0],   # no voters / quorum 0 -> 0
+    ])
+    mask = np.array([
+        [True, True, True, False],
+        [True, True, True, False],
+        [True, True, True, False],
+        [False, False, False, False],
+    ])
+    quorum = np.array([2, 2, 2, 0])
+    out = quorum_bases(bases, mask, quorum)
+    assert out.tolist() == [7.0, 0.0, 5.0, 0.0]
+
+
+def test_quorum_bases_matches_scalar_tracker():
+    rng = np.random.default_rng(7)
+    P = 5
+    for _ in range(50):
+        b = rng.uniform(0.0, 10.0, size=(1, P))
+        mask = np.ones((1, P), bool)
+        q = np.array([P // 2 + 1])
+        vec = quorum_bases(b, mask, q)[0]
+        t = LeaseTracker(CFG)
+        peers = [f"p{i}" for i in range(1, P)]
+        for i, p in enumerate(peers):
+            t.record_send(p, float(b[0, i + 1]))
+            t.record_ack(p)
+        # scalar refresh with self pinned at b[0,0] via now
+        t.refresh(["self"] + peers, "self", now=float(b[0, 0]))
+        expected = CFG.expiry(vec) if vec > 0.0 else 0.0
+        assert t.expiry == pytest.approx(expected)

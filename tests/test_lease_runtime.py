@@ -1,0 +1,466 @@
+"""Lease-based local reads, end to end (docs/INTERNALS.md §20).
+
+Three layers of coverage over both backends:
+
+- actor core (pure Server objects on the in-test Net, fake clock):
+  lease earned by quorum acks, local read serving, expiry + quorum
+  fallback re-earning, eager revocation on deposition, and leader
+  stickiness on (pre-)votes including the forced-candidacy bypass;
+- full runtime (real nodes): lease-served consistent queries, counter
+  movement, staleness-bounded follower reads, and linearizability
+  across a leadership transfer;
+- batch coordinator: the vectorized (G,) lease plane serving reads
+  with zero quorum traffic, plus redirect-hop capping regressions.
+"""
+
+import time
+
+import pytest
+
+from ra_tpu import api, leaderboard
+from ra_tpu.log.memory import MemoryLog
+from ra_tpu.log.meta import InMemoryMeta
+from ra_tpu.machine import SimpleMachine
+from ra_tpu.protocol import (
+    AppendEntriesRpc,
+    ElectionTimeout,
+    RequestVoteRpc,
+)
+from ra_tpu.runtime.transport import registry as node_registry
+from ra_tpu.server import FOLLOWER, LEADER, Server, ServerConfig
+from ra_tpu.system import SystemConfig
+
+from harness import Net
+
+S1, S2, S3 = ("s1", "nodeA"), ("s2", "nodeB"), ("s3", "nodeC")
+IDS = [S1, S2, S3]
+
+
+class FakeClock:
+    """Settable clock satisfying the runtime/clock.py seam."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def monotonic_ns(self) -> int:
+        return int(self.t * 1e9)
+
+    def time(self) -> float:
+        return 1_700_000_000.0 + self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.t += seconds
+
+
+def adder():
+    return SimpleMachine(lambda cmd, state: state + cmd, 0)
+
+
+_UID_SEQ = iter(range(10_000))
+
+
+def lease_server(sid, clk, members=IDS, lease=True, cluster="c1"):
+    # counters live in a process-global registry keyed by
+    # (cluster_name, server_id): give each test's net a distinct
+    # cluster so counts don't leak across tests
+    cfg = ServerConfig(
+        server_id=sid,
+        uid=f"uid_{sid[0]}_{next(_UID_SEQ)}",
+        cluster_name=cluster,
+        machine=adder(),
+        initial_members=tuple(members),
+        counters_enabled=True,
+        clock=clk,
+        lease=lease,
+        election_timeout_s=0.15,
+    )
+    return Server(cfg, MemoryLog(auto_written=True), InMemoryMeta())
+
+
+def lease_net(clk):
+    cluster = f"c{next(_UID_SEQ)}"
+    servers = {sid: lease_server(sid, clk, cluster=cluster) for sid in IDS}
+    return Net(servers)
+
+
+# ---------------------------------------------------------------------------
+# actor core
+
+
+def test_lease_requires_pre_vote():
+    # the config dataclass itself is inert; the check lives in Server
+    cfg = ServerConfig(
+        server_id=S1, uid="u", cluster_name="c1", machine=adder(),
+        initial_members=tuple(IDS), lease=True, pre_vote=False,
+    )
+    with pytest.raises(ValueError, match="pre_vote"):
+        Server(cfg, MemoryLog(auto_written=True), InMemoryMeta())
+
+
+def test_lease_earned_by_quorum_acks_serves_local_read():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    s1 = net.servers[S1]
+    # the election's noop round-trip credited quorum acks
+    assert s1._lease.valid(clk.monotonic())
+    net.command(S1, 7, from_ref="w1")
+    before = len(net.replies)
+    net.deliver(S1, ("consistent_query", lambda s: s, "r1"))
+    # served locally, synchronously — no heartbeat round needed
+    assert ("r1", ("ok", 7, S1)) in net.replies[before:]
+    assert s1.counter.get("read_lease_served") == 1
+    assert s1.counter.get("read_quorum_fallback") == 0
+
+
+def test_lease_expires_then_quorum_fallback_reearns():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    s1 = net.servers[S1]
+    net.command(S1, 3, from_ref="w1")
+    assert s1._lease.valid(clk.monotonic())
+    clk.t += 1.0  # idle leader: lease lapses (no heartbeats on idle)
+    assert not s1._lease.valid(clk.monotonic())
+    net.deliver(S1, ("consistent_query", lambda s: s, "r1"))
+    net.run()  # heartbeat round + acks resolve the read
+    assert ("r1", ("ok", 3, S1)) in net.replies
+    assert s1.counter.get("read_quorum_fallback") == 1
+    assert s1.counter.get("read_lease_expirations") == 1
+    # the fallback round's acks re-earned the lease: next read is local
+    assert s1._lease.valid(clk.monotonic())
+    net.deliver(S1, ("consistent_query", lambda s: s, "r2"))
+    assert ("r2", ("ok", 3, S1)) in net.replies
+    assert s1.counter.get("read_lease_served") == 1
+
+
+def test_lease_revoked_eagerly_on_deposition():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    s1 = net.servers[S1]
+    assert s1._lease.valid(clk.monotonic())
+    # a higher-term AER deposes the leader: revocation is immediate,
+    # not expiry-based — in-flight acks must not resurrect the lease
+    s1.handle(
+        AppendEntriesRpc(
+            term=s1.current_term + 1, leader_id=S2,
+            prev_log_index=s1.log.last_index_term()[0],
+            prev_log_term=s1.log.last_index_term()[1],
+            leader_commit=s1.commit_index, entries=(),
+        ),
+        from_peer=S2,
+    )
+    assert s1.role == FOLLOWER
+    assert not s1._lease.valid(clk.monotonic())
+    assert s1.counter.get("read_lease_revocations") == 1
+    # stale in-flight ack credits nothing (stamps were cleared)
+    s1._lease_credit(S2)
+    assert not s1._lease.valid(clk.monotonic())
+
+
+def test_stickiness_disregards_votes_while_leader_fresh():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    net.command(S1, 1, from_ref="w")
+    s2 = net.servers[S2]
+    term0 = s2.current_term
+    li, lt = s2.log.last_index_term()
+    # a higher-term vote request against a freshly-contacted leader is
+    # disregarded at OUR term — adopting the higher term would depose
+    # the live leader the lease depends on
+    effects = s2.handle(
+        RequestVoteRpc(term=term0 + 5, candidate_id=S3,
+                       last_log_index=li, last_log_term=lt),
+        from_peer=S3,
+    )
+    assert s2.current_term == term0
+    from ra_tpu.effects import Reply, SendRpc
+
+    denies = [
+        e for e in effects
+        if isinstance(e, SendRpc) and not e.msg.vote_granted
+    ]
+    assert denies, effects
+    assert denies[0].msg.term == term0
+    # the forced (leadership-transfer) variant bypasses stickiness
+    s2.handle(
+        RequestVoteRpc(term=term0 + 5, candidate_id=S3,
+                       last_log_index=li, last_log_term=lt, force=True),
+        from_peer=S3,
+    )
+    assert s2.current_term == term0 + 5
+    # and once the promise window lapses, ordinary votes process again
+    s3 = net.servers[S3]
+    clk.t += 0.5
+    li3, lt3 = s3.log.last_index_term()
+    s3.handle(
+        RequestVoteRpc(term=s3.current_term + 7, candidate_id=S2,
+                       last_log_index=li3, last_log_term=lt3),
+        from_peer=S2,
+    )
+    assert s3.current_term == term0 + 7
+
+
+def test_stickiness_gates_standing_for_election():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    net.command(S1, 1, from_ref="w")
+    s2 = net.servers[S2]
+    # an injected timeout while the leader is fresh must NOT campaign:
+    # s2's own (self-granted) vote could be the lease's intersection
+    effects = s2.handle(ElectionTimeout())
+    assert s2.role == FOLLOWER
+    assert effects == []
+    clk.t += 0.5
+    s2.handle(ElectionTimeout())
+    assert s2.role != FOLLOWER  # promise lapsed: free to stand
+
+
+def test_follower_freshness_floor_tracks_leader_stamps():
+    clk = FakeClock()
+    net = lease_net(clk)
+    net.elect(S1)
+    net.command(S1, 5, from_ref="w1")
+    s2 = net.servers[S2]
+    # replication carried leader commit stamps; once applied, the
+    # follower's provable staleness is bounded (≈ drift epsilon here)
+    assert s2.last_applied >= 1
+    st = s2.read_staleness_s()
+    assert st < 1.0, st
+    # lease-off servers never see stamps: staleness stays infinite
+    clk2 = FakeClock()
+    plain = {sid: lease_server(sid, clk2, lease=False) for sid in IDS}
+    net2 = Net(plain)
+    net2.elect(S1)
+    net2.command(S1, 5, from_ref="w1")
+    assert net2.servers[S2].read_staleness_s() == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# full runtime (actor backend)
+
+
+@pytest.fixture
+def lease_cluster(tmp_path):
+    leaderboard.clear()
+    for n in ("lnA", "lnB", "lnC"):
+        cfg = SystemConfig(name="t", data_dir=str(tmp_path))
+        api.start_node(n, cfg, election_timeout_s=0.1,
+                       tick_interval_s=0.1, detector_poll_s=0.05)
+    ids = [("l1", "lnA"), ("l2", "lnB"), ("l3", "lnC")]
+    started, failed = api.start_cluster(
+        "leased", lambda: SimpleMachine(lambda c, s: s + c, 0), ids,
+        extra_cfg={"lease": True},
+    )
+    assert failed == []
+    yield ids
+    for n in ("lnA", "lnB", "lnC"):
+        try:
+            api.stop_node(n)
+        except Exception:
+            pass
+    leaderboard.clear()
+
+
+def _server_of(sid):
+    return node_registry().get(sid[1]).procs[sid[0]].server
+
+
+def test_runtime_lease_serves_reads_locally(lease_cluster):
+    ids = lease_cluster
+    leader = api.wait_for_leader("leased")
+    total = 0
+    for i in range(5):
+        total += i
+        api.process_command(ids[0], i)
+    # write traffic earns the lease; reads then serve with no quorum round
+    deadline = time.monotonic() + 5
+    srv = _server_of(leader)
+    while time.monotonic() < deadline:
+        out = api.consistent_query(ids[0], lambda s: s)
+        assert out[1] == total
+        if srv.counter.get("read_lease_served") > 0:
+            break
+    assert srv.counter.get("read_lease_served") > 0
+
+
+def test_runtime_lease_reads_across_transfer(lease_cluster):
+    ids = lease_cluster
+    leader = api.wait_for_leader("leased")
+    api.process_command(ids[0], 10)
+    target = next(sid for sid in ids if sid != leader)
+    out = api.transfer_leadership(leader, target)
+    assert out[0] == "ok", out
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if api.wait_for_leader("leased", timeout=5) == target:
+            break
+    # linearizable reads stay correct through the deposition — the old
+    # leader revoked its lease before soliciting the forced election
+    assert api.consistent_query(ids[0], lambda s: s, timeout=10)[1] == 10
+    old = _server_of(leader)
+    assert old.counter.get("read_lease_revocations") >= 1
+    api.process_command(ids[0], 1)
+    assert api.consistent_query(ids[0], lambda s: s, timeout=10)[1] == 11
+
+
+def test_runtime_bounded_local_read(lease_cluster):
+    ids = lease_cluster
+    api.wait_for_leader("leased")
+    api.process_command(ids[0], 42)
+    # a generous bound succeeds on some member once stamps propagate
+    deadline = time.monotonic() + 5
+    got = None
+    while time.monotonic() < deadline and got is None:
+        for sid in ids:
+            try:
+                out = api.local_query(sid, lambda s: s, max_staleness_s=30.0)
+            except api.StaleReadError:
+                continue
+            if out[1] == 42:
+                got = out
+                break
+        time.sleep(0.02)
+    assert got is not None
+    # an impossible bound always rejects: provable staleness includes
+    # the drift epsilon, which is strictly positive
+    with pytest.raises(api.StaleReadError) as ei:
+        api.local_query(ids[0], lambda s: s, max_staleness_s=0.0)
+    assert ei.value.staleness > 0.0
+
+
+def test_runtime_bounded_read_rejects_without_lease(tmp_path):
+    leaderboard.clear()
+    try:
+        for n in ("pnA", "pnB", "pnC"):
+            cfg = SystemConfig(name="t", data_dir=str(tmp_path))
+            api.start_node(n, cfg, election_timeout_s=0.1,
+                           tick_interval_s=0.1, detector_poll_s=0.05)
+        ids = [("p1", "pnA"), ("p2", "pnB"), ("p3", "pnC")]
+        _, failed = api.start_cluster(
+            "plain", lambda: SimpleMachine(lambda c, s: s + c, 0), ids
+        )
+        assert failed == []
+        api.process_command(ids[0], 1)
+        # lease-off leaders never stamp freshness: bounded reads fail
+        # conservatively (staleness is infinite), plain reads still work
+        with pytest.raises(api.StaleReadError):
+            api.local_query(ids[1], lambda s: s, max_staleness_s=60.0)
+        assert api.local_query(ids[1], lambda s: s)[0] == "ok"
+    finally:
+        for n in ("pnA", "pnB", "pnC"):
+            try:
+                api.stop_node(n)
+            except Exception:
+                pass
+        leaderboard.clear()
+
+
+# ---------------------------------------------------------------------------
+# batch backend
+
+
+def await_(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def test_batch_lease_serves_reads_locally():
+    from ra_tpu.ops import consensus as C
+    from ra_tpu.runtime.coordinator import BatchCoordinator
+
+    leaderboard.clear()
+    coords = {
+        i: BatchCoordinator(f"bl{i}", capacity=16, num_peers=3, lease=True)
+        for i in range(3)
+    }
+    try:
+        for c in coords.values():
+            c.start()
+        members = [("blg0", f"bl{i}") for i in range(3)]
+        for c in coords.values():
+            c.add_group("blg0", "blcl0", members, adder())
+        coords[0].deliver(("blg0", "bl0"), ElectionTimeout(), None)
+        await_(lambda: coords[0].by_name["blg0"].role == C.R_LEADER,
+               what="election")
+        sid = ("blg0", "bl0")
+        total = 0
+        for i in range(5):
+            total += i + 1
+            api.process_command(sid, i + 1, timeout=20)
+        # replication acks earned the lease: reads serve locally
+        deadline = time.monotonic() + 10
+        c0 = coords[0]
+        while time.monotonic() < deadline:
+            out = api.consistent_query(sid, lambda s: s, timeout=20)
+            assert out[1] == total
+            if c0.counters.get("read_lease_served") > 0:
+                break
+        assert c0.counters.get("read_lease_served") > 0
+        # bounded local read on a follower: stamps flowed via AERs
+        def bounded_ok():
+            try:
+                out2 = api.local_query(("blg0", "bl1"), lambda s: s,
+                                       max_staleness_s=30.0)
+            except api.StaleReadError:
+                return False
+            return out2[1] == total
+        await_(bounded_ok, timeout=10, what="bounded follower read")
+        with pytest.raises(api.StaleReadError):
+            api.local_query(("blg0", "bl1"), lambda s: s,
+                            max_staleness_s=0.0)
+    finally:
+        for c in coords.values():
+            c.stop()
+        leaderboard.clear()
+
+
+# ---------------------------------------------------------------------------
+# redirect-hop capping (satellite regression)
+
+
+def test_leader_query_redirect_hops_capped(monkeypatch):
+    """Two deposed members pointing at each other must terminate in a
+    bounded number of hops, not recurse until the stack blows."""
+    a, b = ("rq", "nX"), ("rq", "nY")
+    sent = []
+
+    def fake_send(sid, msg):
+        sent.append(sid)
+        fut = msg[2]
+        fut.set_result(("redirect", b if sid == a else a))
+        return True
+
+    monkeypatch.setattr(api, "_try_send", fake_send)
+    with pytest.raises(api.RaError):
+        api.leader_query(a, lambda s: s, timeout=5.0)
+    assert len(sent) <= api.MAX_REDIRECT_HOPS + 1
+
+
+def test_consistent_query_redirect_cycle_times_out(monkeypatch):
+    a, b = ("cq", "nX"), ("cq", "nY")
+    calls = {"n": 0}
+
+    def fake_send(sid, msg):
+        calls["n"] += 1
+        fut = msg[2]
+        fut.set_result(("redirect", b if sid == a else a))
+        return True
+
+    monkeypatch.setattr(api, "_try_send", fake_send)
+    t0 = time.monotonic()
+    with pytest.raises(api.RaError, match="timed out"):
+        api.consistent_query(a, lambda s: s, timeout=0.5)
+    assert time.monotonic() - t0 < 5.0
+    assert calls["n"] >= 2

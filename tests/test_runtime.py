@@ -20,8 +20,13 @@ from ra_tpu.system import SystemConfig
 
 
 @pytest.fixture
-def cluster(tmp_path):
-    """Three nodes + a 3-member cluster running an adder machine."""
+def cluster(tmp_path, request):
+    """Three nodes + a 3-member cluster running an adder machine.
+
+    Indirect-parametrize with True to start the cluster lease-enabled
+    (docs/INTERNALS.md §20); the default stays lease-off.
+    """
+    lease = bool(getattr(request, "param", False))
     leaderboard.clear()
     nodes = []
     for n in ("nA", "nB", "nC"):
@@ -30,7 +35,8 @@ def cluster(tmp_path):
                                     tick_interval_s=0.1, detector_poll_s=0.05))
     ids = [("s1", "nA"), ("s2", "nB"), ("s3", "nC")]
     started, failed = api.start_cluster(
-        "add", lambda: SimpleMachine(lambda c, s: s + c, 0), ids
+        "add", lambda: SimpleMachine(lambda c, s: s + c, 0), ids,
+        extra_cfg={"lease": True} if lease else None,
     )
     assert failed == []
     yield ids
@@ -56,6 +62,8 @@ def test_process_command_roundtrip(cluster):
     assert reply == 12
 
 
+@pytest.mark.parametrize("cluster", [False, True], indirect=True,
+                         ids=["lease-off", "lease-on"])
 def test_queries(cluster):
     api.process_command(cluster[0], 10)
     # local query on every member converges

@@ -471,8 +471,12 @@ def test_leadership_transfer():
     assert net.servers[S1].role == FOLLOWER
 
 
-def test_consistent_query_quorum_roundtrip():
-    net = elected_leader()
+@pytest.mark.parametrize("lease", [False, True], ids=["lease-off", "lease-on"])
+def test_consistent_query_quorum_roundtrip(lease):
+    # with the lease on, the read may serve locally (no heartbeat round)
+    # or fall back to the quorum round — either way the reply shape and
+    # linearizability contract are identical (docs/INTERNALS.md §20)
+    net = elected_leader(three_node_net(adder, lease=lease))
     net.command(S1, 9)
     net.deliver(S1, ("consistent_query", lambda st: st * 2, "q1"))
     net.run()

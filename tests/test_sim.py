@@ -15,6 +15,7 @@ pinned so failures are immediately reproducible.
 
 import pytest
 
+import ra_tpu.lease as lease_mod
 import ra_tpu.models.fifo as fifo_mod
 from ra_tpu.sim import (
     Schedule,
@@ -151,6 +152,99 @@ def test_shrink_refuses_passing_schedule():
         shrink(sched)
 
 
+# -- clock-bound leader leases (docs/INTERNALS.md §20) -----------------------------
+
+
+def _lease_deposition_sched(seed: int) -> Schedule:
+    """A deposition raced against the old leader's lease window, with
+    leader-relative ops so it lands on every seed despite election
+    jitter: steady writes keep the lease basis fresh (last one at
+    2990ms, just before the cut), the leader is isolated at 3000ms, a
+    deterministic ElectionTimeout at 3170ms promotes a follower whose
+    stickiness promise has lapsed, a write to the NEW leader raises the
+    acked floor, and dense consistent reads hit the OLD leader inside
+    [new ack, old basis + bugged expiry]. Honest lease math has the old
+    leader's lease expired (~basis + elt*safety - eps ≈ 3108ms) so
+    those reads queue silently; the flipped drift bound keeps it alive
+    to ~3262ms and serves stale state."""
+    ops = [(t, ("cmd", ("put", "seq", 0))) for t in range(600, 2801, 200)]
+    ops += [
+        (2990, ("cmd", ("put", "seq", 0))),
+        (3000, ("isolate", "leader")),
+        (3170, ("etimo", "other")),
+        (3200, ("cmd", ("put", "seq", 0))),
+        (3215, ("read", "old")),
+        (3230, ("read", "old")),
+        (3245, ("read", "old")),
+        (3255, ("read", "old")),
+        (3400, ("unblock",)),
+    ]
+    return Schedule(seed=seed, workload="kvread", lease=True,
+                    horizon_ms=4_000, settle_ms=2_000, ops=tuple(ops))
+
+
+@pytest.mark.parametrize("seed", [1, 3, 8])
+def test_lease_reads_linearizable_under_skew_and_faults(seed):
+    """Generated kvread runs — writes racing dense consistent reads
+    across all nodes — stay linearizable with leases on, per-node clock
+    rate skew at the covered bound (10_000 ppm), and the full fault mix
+    including nemesis oneway partitions. The reply recorder's floor
+    oracle rejects any consistent read older than the acks that
+    preceded its invocation."""
+    r = run_schedule(Schedule(seed=seed, workload="kvread", lease=True,
+                              skew_ppm=10_000, **FAULTS))
+    assert r.ok, r.violations
+    assert len(set(r.final.values())) == 1, r.final
+
+
+def test_lease_deposition_race_is_safe_with_honest_math():
+    """The adversarial deposition schedule itself is clean when the
+    drift bound is honest: the deposed leader's lease has expired
+    before the stale window opens, so its reads never answer."""
+    r = run_schedule(_lease_deposition_sched(1))
+    assert r.ok, r.violations
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_lease_drift_bound_bug_caught_and_shrunk(seed, monkeypatch):
+    """Oracle teeth: flipping the lease margin terms from shrink to
+    extend (SIM_BUG_DRIFT_BOUND) must trip the stale-read oracle on
+    EVERY seed of the deposition schedule, and ddmin must cut the
+    repro to a handful of ops that still fail with the bug and pass
+    without it."""
+    monkeypatch.setattr(lease_mod, "SIM_BUG_DRIFT_BOUND", True)
+    r = run_schedule(_lease_deposition_sched(seed))
+    assert not r.ok, "planted lease drift-bound bug went undetected"
+    assert "stale consistent read" in r.violations[0], r.violations
+
+    if seed != 1:
+        return  # shrink once; catching the bug is the per-seed claim
+    minimized, replays = shrink(r.schedule)
+    assert len(minimized.ops) <= 10, \
+        f"shrinker left {len(minimized.ops)} ops ({replays} replays)"
+    assert not run_schedule(minimized).ok, \
+        "minimized schedule no longer reproduces the bug"
+
+    monkeypatch.setattr(lease_mod, "SIM_BUG_DRIFT_BOUND", False)
+    assert run_schedule(minimized).ok, \
+        "minimized schedule fails even without the planted bug"
+
+
+def test_lease_schedule_dump_replays_identically():
+    """dumps/loads round-trips the lease fields (lease, skew_ppm) and
+    the read/isolate/etimo/unblock op vocabulary, and the reloaded
+    schedule replays byte-identically."""
+    sched = _lease_deposition_sched(2)
+    a = run_schedule(sched)
+    reloaded = loads(dumps(a.schedule))
+    assert reloaded.lease is True
+    assert reloaded.skew_ppm == sched.skew_ppm
+    assert reloaded.ops == a.schedule.ops
+    b = run_schedule(reloaded)
+    assert b.trace_text == a.trace_text
+    assert b.final == a.final
+
+
 # -- component behavior -----------------------------------------------------------
 
 
@@ -225,7 +319,7 @@ def test_transport_inflight_messages_eaten_by_partition():
 
 
 @pytest.mark.sim
-@pytest.mark.parametrize("workload", ["kv", "fifo", "session"])
+@pytest.mark.parametrize("workload", ["kv", "fifo", "session", "kvread"])
 def test_sim_sweep_lane(workload, sim_seed_base):
     from ra_tpu.sim.explorer import explore
 

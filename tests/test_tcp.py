@@ -45,10 +45,12 @@ def tcp_cluster(tmp_path):
     leaderboard.clear()
 
 
-def test_consensus_over_tcp(tcp_cluster):
+@pytest.mark.parametrize("lease", [False, True], ids=["lease-off", "lease-on"])
+def test_consensus_over_tcp(tcp_cluster, lease):
     ids, names = tcp_cluster
     started, failed = api.start_cluster(
-        "tcpc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids, timeout=15
+        "tcpc", lambda: SimpleMachine(lambda c, s: s + c, 0), ids, timeout=15,
+        extra_cfg={"lease": True} if lease else None,
     )
     assert failed == []
     reply, leader = api.process_command(ids[0], 5, timeout=10)
