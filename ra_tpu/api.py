@@ -63,6 +63,25 @@ class StaleReadError(RaError):
         self.leader_hint = leader_hint
 
 
+class RaNoSpace(RaError):
+    """Typed ``RA_NOSPACE`` backoff error (docs/INTERNALS.md §21): the
+    target node is storage-degraded (space-class WAL failure or hard
+    disk watermark) and kept rejecting the command for the caller's
+    whole deadline. The command was provably never appended — the node
+    classifies ENOSPC/EDQUOT before any log mutation — so retrying
+    later is exactly-once safe. ``code`` is the stable machine-readable
+    tag (always ``"RA_NOSPACE"``)."""
+
+    code = "RA_NOSPACE"
+
+    def __init__(self, target):
+        super().__init__(
+            f"RA_NOSPACE: {target} is storage-degraded (no disk space); "
+            f"command was not appended — back off and retry"
+        )
+        self.target = target
+
+
 def _node(node_name: str) -> RaNode:
     node = node_registry().get(node_name)
     if node is None:
@@ -286,6 +305,7 @@ def process_command(
     target = server_id
     tried: set = set()
     backoff = 0.01
+    last_reject = None  # "overloaded" | "nospace" — types the timeout
     while time.monotonic() < deadline:
         fut = Future()
         cmd = Command(kind=USR, data=data, reply_mode="await_consensus",
@@ -330,13 +350,16 @@ def process_command(
             continue
         if reply[0] == "reject":
             # reject-with-backoff: the leader's admission window is
-            # full. Hold off, then retry the SAME leader — the command
+            # full ("overloaded") or its storage is degraded
+            # ("nospace", docs/INTERNALS.md §21). Hold off, then retry
+            # the SAME leader — the command
             # was never appended, so no duplicate risk. tried is not
             # updated: this member is healthy. When the reject carries
             # a window-release gate (both backends do), park on IT —
             # the server wakes us the moment apply progress (or a ring
             # drain) frees room, so the backoff only bounds the wait;
             # a bare 2-tuple reject falls back to the bounded sleep.
+            last_reject = reply[1]
             wait_s = min(backoff, max(0.0, deadline - time.monotonic()))
             gate = reply[2] if len(reply) > 2 else None
             if gate is not None:
@@ -346,6 +369,8 @@ def process_command(
             backoff = min(backoff * 2, 0.25)
             continue
         raise RaError(f"command failed: {reply!r}")
+    if last_reject == "nospace":
+        raise RaNoSpace(target)
     raise RaError("command timed out")
 
 

@@ -30,6 +30,10 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
      "client commands rejected with overloaded (admission window)"),
     ("commands_dropped_overload", "counter",
      "ack-free commands dropped past the admission window"),
+    ("commands_rejected_nospace", "counter",
+     "client commands rejected with the typed RA_NOSPACE reason while "
+     "the node's storage plane was degraded or hard-watermarked "
+     "(docs/INTERNALS.md §21)"),
     ("stale_peer_resends", "counter",
      "pipeline-window stalls resolved by rewinding to the peer match"),
     ("msgs_sent", "counter", "protocol messages sent"),
@@ -46,6 +50,13 @@ RA_SERVER_FIELDS: List[FieldSpec] = [
     ("snapshot_installed", "counter", "snapshots installed (follower)"),
     ("snapshot_send_failures", "counter",
      "snapshot sender deaths (backoff retries armed)"),
+    ("snapshot_credits_granted", "counter",
+     "chunk credits granted to snapshot senders (receiver-paced flow "
+     "control; docs/INTERNALS.md §21)"),
+    ("snapshot_credit_waits", "counter",
+     "sender backoffs taken on credit starvation (receiver granted 0)"),
+    ("snapshot_credit_window", "gauge",
+     "last credit window granted by / observed at this server"),
     ("checkpoints_written", "counter", "checkpoints written"),
     ("recovery_checkpoint_used", "counter", "boots that skipped replay"),
     ("checkpoints_promoted", "counter", "checkpoints promoted to snapshots"),
@@ -104,6 +115,9 @@ WAL_FIELDS: List[FieldSpec] = [
     ("out_of_seq", "counter", "out-of-sequence writes detected"),
     ("rollovers", "counter", "WAL file rollovers"),
     ("failures", "counter", "I/O failures (WAL entered failed state)"),
+    ("space_failures", "counter",
+     "failures classified space-class (ENOSPC/EDQUOT): the node "
+     "degrades and probe-resumes instead of restarting from disk"),
     ("group_commit_waits", "counter",
      "flushes that held the batch open coalescing an arriving burst "
      "(adaptive group commit; docs/INTERNALS.md §15)"),
@@ -128,6 +142,16 @@ COORDINATOR_FIELDS: List[FieldSpec] = [
      "client commands rejected with overloaded (reject-with-backoff)"),
     ("commands_dropped_overload", "counter",
      "ack-free (noreply) commands dropped past the admission window"),
+    ("commands_rejected_nospace", "counter",
+     "client commands rejected with the typed RA_NOSPACE reason while "
+     "the coordinator's storage plane was degraded or hard-watermarked"),
+    ("snapshot_credits_granted", "counter",
+     "chunk credits granted to snapshot senders (receiver-paced flow "
+     "control; docs/INTERNALS.md §21)"),
+    ("snapshot_credit_waits", "counter",
+     "sender backoffs taken on credit starvation (receiver granted 0)"),
+    ("snapshot_credit_window", "gauge",
+     "last credit window granted by this coordinator's accept path"),
     ("pending_redirected", "counter",
      "pending client futures answered with a redirect on deposition/"
      "truncation instead of being silently dropped"),
@@ -253,6 +277,12 @@ HEALTH_FIELDS: List[FieldSpec] = [
      "worst follower match gap across this node's led groups"),
     ("health_max_backlog", "gauge",
      "worst appended-but-unapplied admission backlog"),
+    ("health_disk_pressure", "gauge",
+     "node disk-pressure anomaly state (0=clear 1=soft 2=hard; "
+     "hysteresis applied by the watermark controller, "
+     "docs/INTERNALS.md §21)"),
+    ("health_disk_transitions", "counter",
+     "disk-pressure anomaly state transitions"),
 ]
 
 # Per-watched-peer phi-accrual gauges (name ("phi", owner, target);
@@ -279,6 +309,13 @@ NEMESIS_FIELDS: List[FieldSpec] = [
     ("nemesis_disk_injected", "counter",
      "disk failpoints armed (faults.py registry)"),
     ("nemesis_disk_healed", "counter", "disk failpoints disarmed"),
+    ("nemesis_disk_full_injected", "counter",
+     "ENOSPC/EDQUOT storms armed (storage-pressure survival plane)"),
+    ("nemesis_disk_full_healed", "counter", "ENOSPC storms disarmed"),
+    ("nemesis_slow_disk_injected", "counter",
+     "fsync-latency brownout failpoints armed"),
+    ("nemesis_slow_disk_healed", "counter",
+     "fsync-latency failpoints disarmed"),
     ("nemesis_crash_injected", "counter",
      "node/coordinator crash-restarts injected"),
     ("nemesis_crash_healed", "counter",
@@ -320,6 +357,10 @@ SIM_FIELDS: List[FieldSpec] = [
     ("sim_minimized_ops", "counter",
      "ops in the last minimized repro schedule"),
     ("sim_virtual_ms", "counter", "virtual milliseconds simulated"),
+    ("sim_disk_exhaustions", "counter",
+     "simulated nodes that ran out of their disk byte budget"),
+    ("sim_disk_parked_writes", "counter",
+     "write confirmations parked while a sim node was space-degraded"),
 ]
 
 # Session/lock-service machine (ra_tpu/models/session.py). The vector

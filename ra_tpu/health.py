@@ -133,6 +133,10 @@ class HealthScanner:
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._names: List[Optional[str]] = [None] * capacity
         self._clusters: List[Optional[str]] = [None] * capacity
+        # node-scope disk-pressure anomaly (0=clear 1=soft 2=hard); the
+        # hysteresis lives in pressure.DiskWatermark — this is the
+        # published, transition-evented mirror (docs/INTERNALS.md §21)
+        self.disk_pressure = 0
         self._alloc(capacity)
 
     def _alloc(self, capacity: int) -> None:
@@ -353,6 +357,34 @@ class HealthScanner:
         c.put("health_max_match_gap", int(match_gap.max(initial=0)))
         c.put("health_max_backlog", int(backlog.max(initial=0)))
 
+    # -- node-scope anomalies ----------------------------------------------
+
+    DISK_STATE_NAMES = {0: "clear", 1: "soft", 2: "hard"}
+
+    def note_disk_pressure(self, state: int) -> None:
+        """Publish the node's disk-pressure tri-state (computed with
+        hysteresis by :class:`ra_tpu.pressure.DiskWatermark`). Unlike
+        the per-group states this is node-scope: one value, driven by
+        the owner's detector thread alongside ``scan``. Transitions
+        emit a ``health_transition`` flight-recorder event so pressure
+        onsets line up with WAL failures / elections on one timeline."""
+        state = int(state)
+        prev = self.disk_pressure
+        if state == prev:
+            return
+        self.disk_pressure = state
+        self.counters.put("health_disk_pressure", state)
+        self.counters.incr("health_disk_transitions")
+        from ra_tpu import obs as _obs
+
+        _obs.record_event(
+            "health_transition", node=self.node, group="",
+            detail=(
+                f"disk_pressure {self.DISK_STATE_NAMES.get(prev, prev)}->"
+                f"{self.DISK_STATE_NAMES.get(state, state)}"
+            ),
+        )
+
     # -- reads -------------------------------------------------------------
 
     def rows(self) -> List[Dict[str, Any]]:
@@ -400,6 +432,9 @@ class HealthScanner:
                 "lagging": c.get("health_lagging"),
                 "quiet": c.get("health_quiet"),
             },
+            "disk_pressure": self.DISK_STATE_NAMES.get(
+                self.disk_pressure, self.disk_pressure
+            ),
             "reads": self._read_totals(),
         }
 

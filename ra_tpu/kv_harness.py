@@ -153,6 +153,8 @@ def run(
     op_timeout: float = 10.0,
     rescue: bool = False,
     disk_faults: bool = False,
+    disk_full: bool = False,
+    slow_disk: bool = False,
     overload: bool = False,
     rings: bool = True,
     workload: str = "kv",
@@ -190,6 +192,18 @@ def run(
     the disk-fault/torn-write failpoints are proven to bite through the
     native fallback seam.
 
+    ``disk_full=True`` adds the storage-pressure survival dimension
+    (docs/INTERNALS.md §21): persistent ENOSPC/EDQUOT storms against a
+    random node's WAL. The node must flip into ``storage_degraded``
+    (typed RA_NOSPACE rejects, heartbeats/elections/lease reads keep
+    running), survive the storm with zero acked writes lost, and
+    auto-resume once the storm heals — the flight-recorder dump on
+    failure interleaves the ``storage_degraded``/``storage_resumed``
+    transitions with the nemesis schedule. ``slow_disk=True`` arms
+    persistent fsync-latency faults instead; on the actor backend the
+    nodes run with a lowered brownout threshold so the nemesis
+    latencies (20-50 ms) trip the detector and shed leadership.
+
     ``lease=True`` is the linearizable-read dimension (docs/
     INTERNALS.md §20): servers run with clock-bound leader leases so
     consistent reads serve locally, one-way partitions join the nemesis
@@ -212,12 +226,14 @@ def run(
     if backend == "per_group_actor":
         return _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                           membership, op_timeout, rescue, disk_faults,
+                          disk_full=disk_full, slow_disk=slow_disk,
                           overload=overload, workload=workload,
                           combined=combined, lease=lease)
     if backend == "tpu_batch":
         return _run_batch(seed, n_ops, nodes, partitions, membership,
                           op_timeout, rescue, restarts=restarts,
-                          disk_faults=disk_faults, data_dir=data_dir,
+                          disk_faults=disk_faults, disk_full=disk_full,
+                          slow_disk=slow_disk, data_dir=data_dir,
                           overload=overload, rings=rings, workload=workload,
                           combined=combined, native=native, lease=lease)
     raise ValueError(f"unknown backend {backend!r}")
@@ -665,7 +681,8 @@ class _FifoWorkload:
 
 def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                membership, op_timeout, rescue=False,
-               disk_faults=False, overload=False, workload="kv",
+               disk_faults=False, disk_full=False, slow_disk=False,
+               overload=False, workload="kv",
                combined=False, lease=False) -> HarnessResult:
     import tempfile
 
@@ -690,6 +707,13 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                 # snapshots; at harness scale that hides reclamation —
                 # snapshot on every cursor so the fifo checker can see it
                 min_snapshot_interval=1,
+                # the slow_disk nemesis delays fsync by 20-50 ms — well
+                # under the production 200 ms brownout threshold, so the
+                # lane lowers it (and ticks faster) to prove the
+                # detect->shed->recover loop end to end
+                brownout_enter_us=10_000.0 if slow_disk else 200_000.0,
+                brownout_exit_us=2_000.0 if slow_disk else 50_000.0,
+                disk_check_interval_s=0.1 if slow_disk else 1.0,
             ),
             election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
         )
@@ -771,7 +795,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
 
     dims = nem.standard_dimensions(
         partitions=partitions, oneway=combined or lease,
-        disk_faults=disk_faults,
+        disk_faults=disk_faults, disk_full=disk_full, slow_disk=slow_disk,
         restarts=restarts, membership=membership, overload=combined,
         mode_flips=False)
     ctx = nem.NemesisContext(
@@ -898,6 +922,15 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                     # random node's storage; node supervision must heal it
                     counts["disk_fault"] = counts.get("disk_fault", 0) + 1
                     planner.fire("disk", rng, op_i)
+                elif roll < 0.985 and disk_full:
+                    # persistent ENOSPC/EDQUOT storm: the node must flip
+                    # into storage_degraded, not restart; a second roll
+                    # while storming heals it (bounds the episode)
+                    counts["disk_full"] = counts.get("disk_full", 0) + 1
+                    planner.fire("disk_full", rng, op_i)
+                elif roll < 0.993 and slow_disk:
+                    counts["slow_disk"] = counts.get("slow_disk", 0) + 1
+                    planner.fire("slow_disk", rng, op_i)
                 elif membership and planner.sym_victim is None:
                     # membership changes only on a healed cluster: removing
                     # an alive member while another is partitioned away can
@@ -982,7 +1015,7 @@ def _run_actor(seed, n_ops, nodes, data_dir, partitions, restarts,
                 _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         anomalies = _capture_health(model.failures)
-        if disk_faults:
+        if disk_faults or disk_full or slow_disk:
             faults.disarm_all()
         for n in names:
             try:
@@ -1042,6 +1075,7 @@ def _dump_on_failure(failures, label: str, anomalies=None,
 
 def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                rescue=False, restarts=False, disk_faults=False,
+               disk_full=False, slow_disk=False,
                data_dir=None, overload=False, rings=True, workload="kv",
                combined=False, native="auto", lease=False) -> HarnessResult:
     import tempfile
@@ -1061,7 +1095,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
     # restarts/disk_faults need real durability: WAL-backed logs, a
     # file meta store, and per-node storage that a crash-restart can
     # rebuild from (VERDICT item 7's crash-restart nemesis shape)
-    use_disk = restarts or disk_faults
+    use_disk = restarts or disk_faults or disk_full or slow_disk
     base = (data_dir or tempfile.mkdtemp(prefix="ra_kv_batch_")) if use_disk else None
     storage: Dict[str, dict] = {}
     model = _Model()
@@ -1259,7 +1293,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
     dims = nem.standard_dimensions(
         partitions=partitions, oneway=combined or lease,
-        disk_faults=disk_faults,
+        disk_faults=disk_faults, disk_full=disk_full, slow_disk=slow_disk,
         restarts=use_disk and restarts, membership=membership,
         overload=combined, mode_flips=combined)
     ctx = nem.NemesisContext(
@@ -1275,18 +1309,37 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
 
     def check_infra():
         """Per-op storage health sweep (the batch backend has no RaNode
-        supervisor): a failed WAL means unknown durability — rebuild the
-        whole coordinator from disk (fsync-poison rule); a dead infra
-        thread is revived in place with its queue intact."""
+        supervisor): an integrity-class WAL failure means unknown
+        durability — rebuild the whole coordinator from disk (fsync-
+        poison rule); a SPACE-class failure (ENOSPC/EDQUOT,
+        docs/INTERNALS.md §21) provably corrupted nothing, so the
+        coordinator degrades in place — admission flips to RA_NOSPACE
+        rejects, this sweep probes ``reopen()`` each op (the failpoint
+        seam keeps it failing while the storm is armed), and on resume
+        the groups get ``wal_up`` to resend their memtable tails — no
+        restart, no lost acked state. A dead infra thread is revived in
+        place with its queue intact."""
         for n in names:
             st = storage.get(n)
             if st is None:
                 continue
-            if st["wal"].failed:
+            wal = st["wal"]
+            if wal.degraded:
+                c = coords[n]
+                if c.pressure.enter_degraded(detail="wal space storm"):
+                    counts["batch_degraded"] = (
+                        counts.get("batch_degraded", 0) + 1)
+                if wal.reopen():
+                    c.pressure.exit_degraded()
+                    counts["batch_resumed"] = (
+                        counts.get("batch_resumed", 0) + 1)
+                    for uid in list(c.by_name):
+                        c.wal_notify(uid, ("wal_up",))
+            elif wal.failed:
                 restart_coord(n)
             else:
-                if not st["wal"].thread_alive():
-                    st["wal"].revive_thread()
+                if not wal.thread_alive():
+                    wal.revive_thread()
                 if not st["sw"].thread_alive():
                     st["sw"].revive_thread()
 
@@ -1367,6 +1420,14 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                 elif roll < 0.96 and disk_faults:
                     counts["disk_fault"] = counts.get("disk_fault", 0) + 1
                     planner.fire("disk", rng, op_i)
+                elif roll < 0.975 and disk_full:
+                    # ENOSPC storm: check_infra must keep the coordinator
+                    # alive degraded (no restart) until the storm heals
+                    counts["disk_full"] = counts.get("disk_full", 0) + 1
+                    planner.fire("disk_full", rng, op_i)
+                elif roll < 0.985 and slow_disk:
+                    counts["slow_disk"] = counts.get("slow_disk", 0) + 1
+                    planner.fire("slow_disk", rng, op_i)
                 elif membership and planner.sym_victim is None:
                     counts["membership"] = counts.get("membership", 0) + 1
                     planner.fire("membership", rng, op_i)
@@ -1452,7 +1513,7 @@ def _run_batch(seed, n_ops, nodes, partitions, membership, op_timeout,
                 _overload_phase(model, cluster, op_timeout, counts, seed)
     finally:
         anomalies = _capture_health(model.failures)
-        if disk_faults:
+        if disk_faults or disk_full or slow_disk:
             faults.disarm_all()
         for c in coords.values():
             c.stop()
@@ -1498,6 +1559,15 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     ap.add_argument("--disk-faults", action="store_true",
                     help="enable the seeded storage-nemesis dimension "
                          "(failpoint storms; WAL-backed logs on tpu_batch)")
+    ap.add_argument("--disk-full", action="store_true",
+                    help="storage-pressure survival dimension: persistent "
+                         "ENOSPC/EDQUOT storms — nodes must degrade "
+                         "(RA_NOSPACE), not restart, and auto-resume on "
+                         "heal (docs/INTERNALS.md §21)")
+    ap.add_argument("--slow-disk", action="store_true",
+                    help="persistent fsync-latency faults; actor nodes "
+                         "run a lowered brownout threshold so detection "
+                         "sheds leadership off the browning-out node")
     ap.add_argument("--overload", action="store_true",
                     help="build the backends with a small admission "
                          "window and drive past it after the nemesis "
@@ -1510,6 +1580,12 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
                           "crash-restarts over WAL-backed logs on tpu_batch)")
     grp.add_argument("--no-restarts", dest="restarts", action="store_false",
                      help="force the restart dimension off")
+    ap.add_argument("--no-partitions", dest="partitions",
+                    action="store_false", default=True,
+                    help="drop the partition dimension from the mix")
+    ap.add_argument("--no-membership", dest="membership",
+                    action="store_false", default=True,
+                    help="drop the membership-churn dimension")
     ap.add_argument("--rings", choices=("on", "off"), default="on",
                     help="off: batch backend runs the lock+deque "
                          "control command plane (A/B escape hatch)")
@@ -1525,6 +1601,8 @@ if __name__ == "__main__":  # pragma: no cover — ops entry point
     args = ap.parse_args()
     res = run(seed=args.seed, n_ops=args.ops, backend=args.backend,
               restarts=args.restarts, disk_faults=args.disk_faults,
+              disk_full=args.disk_full, slow_disk=args.slow_disk,
+              partitions=args.partitions, membership=args.membership,
               overload=args.overload, rings=args.rings == "on",
               workload=args.workload, combined=args.combined,
               native=args.native, lease=args.lease)

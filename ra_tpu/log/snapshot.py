@@ -217,10 +217,17 @@ class SnapshotStore:
             d = os.path.join(server_dir, kind)
             os.makedirs(d, exist_ok=True)
             # a crash mid-write/mid-accept leaves .writing/.accepting
-            # spool dirs; they are not valid captures — clear them
+            # (or legacy .partial) spool dirs; they are not valid
+            # captures — clear them. Orphaned accept spools also count
+            # against the disk watermark budget (docs/INTERNALS.md
+            # §21), so boot reclaims the bytes, durably.
+            cleared = False
             for name in os.listdir(d):
-                if name.endswith(".writing") or name.endswith(".accepting"):
+                if name.endswith((".writing", ".accepting", ".partial")):
                     shutil.rmtree(os.path.join(d, name), ignore_errors=True)
+                    cleared = True
+            if cleared:
+                sync_dir(d)
 
     # -- naming -------------------------------------------------------------
 
@@ -278,15 +285,26 @@ class SnapshotStore:
         return final
 
     def _prune_older(self, kind: str, below_idx: int) -> None:
+        pruned = False
         for idx, term, path in self._list(kind):
             if idx < below_idx:
                 shutil.rmtree(path, ignore_errors=True)
+                pruned = True
+        if pruned:
+            # make the unlink durable: an un-fsynced directory entry can
+            # resurrect the pruned capture after a crash, silently
+            # re-consuming the bytes emergency reclamation just freed
+            sync_dir(self._kind_dir(kind))
 
     def _prune_count(self, kind: str, max_n: int) -> None:
         entries = self._list(kind)
+        pruned = False
         while len(entries) > max_n:
             idx, term, path = entries.pop(0)
             shutil.rmtree(path, ignore_errors=True)
+            pruned = True
+        if pruned:
+            sync_dir(self._kind_dir(kind))
 
     # -- reads ---------------------------------------------------------------
 

@@ -167,6 +167,11 @@ class Wal:
         # the analog of the reference WAL process crashing and being
         # supervisor-restarted (src/ra_log_wal.erl + ra_log_wal_sup)
         self._failed = False
+        # errno-aware failure taxonomy (docs/INTERNALS.md §21): set
+        # alongside _failed to "space" (ENOSPC/EDQUOT — durable state
+        # provably untouched, node degrades and probe-resumes) or
+        # "integrity" (everything else — the poison path, unchanged)
+        self.failure_class: Optional[str] = None
         self.on_failure: Optional[Callable[[BaseException], None]] = None
         # serializes file I/O (writer thread) against reopen() (restart
         # thread) — without it a reopen can close the file mid-write
@@ -653,22 +658,23 @@ class Wal:
         # the whole writer (batch unacked, _failed set) and reopen()
         # abandons the file — a later fsync on the same fd must never
         # "succeed" and ack entries the kernel already dropped
+        # the timed window covers the failpoint fire + flush + syscall:
+        # the brownout detector differences fsyncs/fsync_time_us, and an
+        # injected ("latency", s) fault must look exactly like the slow
+        # device it models
+        t0 = time.perf_counter_ns()
         faults.fire("wal.fsync", self.fault_scope)
         self._file.flush()
         if self.sync_method == "datasync":
-            t0 = time.perf_counter_ns()
             os.fdatasync(self._file.fileno())
-            dt = time.perf_counter_ns() - t0
-            self.counter.incr("fsyncs")
-            self.counter.incr("fsync_time_us", dt // 1000)
-            self._h_fsync.record(dt)
         elif self.sync_method == "sync":
-            t0 = time.perf_counter_ns()
             os.fsync(self._file.fileno())
-            dt = time.perf_counter_ns() - t0
-            self.counter.incr("fsyncs")
-            self.counter.incr("fsync_time_us", dt // 1000)
-            self._h_fsync.record(dt)
+        else:
+            return
+        dt = time.perf_counter_ns() - t0
+        self.counter.incr("fsyncs")
+        self.counter.incr("fsync_time_us", dt // 1000)
+        self._h_fsync.record(dt)
 
     def _uid_ref(self, uid: str, records: List[Tuple]) -> int:
         ref = self._uid_refs.get(uid)
@@ -780,14 +786,24 @@ class Wal:
             self._rollover()
 
     def _fail(self, exc: BaseException) -> None:
+        # both framers (native write_batch re-raises -(1000+errno) as a
+        # real OSError; the Python path raises the OSError directly)
+        # funnel here, so one classification covers both — the
+        # native/Python parity the taxonomy tests assert is structural
+        from ra_tpu.pressure import CLASS_SPACE, classify_storage_error
+
+        klass = classify_storage_error(exc)
         with self._cv:
             if self._failed:
                 return  # one failure episode -> one on_failure callback
             self._failed = True
+            self.failure_class = klass
         self.counter.incr("failures")
+        if klass == CLASS_SPACE:
+            self.counter.incr("space_failures")
         self._obs_rec.record(
             "wal_failure", node=self.fault_scope,
-            detail=f"{type(exc).__name__}: {exc}",
+            detail=f"{klass}: {type(exc).__name__}: {exc}",
         )
         cb = self.on_failure
         if cb is not None:
@@ -799,6 +815,13 @@ class Wal:
     @property
     def failed(self) -> bool:
         return self._failed
+
+    @property
+    def degraded(self) -> bool:
+        """True while the live failure episode is space-class: the node
+        is in storage_degraded (admission rejects RA_NOSPACE, probe
+        loop armed) rather than poisoned."""
+        return self._failed and self.failure_class == "space"
 
     def thread_alive(self) -> bool:
         """Writer-thread liveness for the node's infra supervisor
@@ -839,8 +862,16 @@ class Wal:
                             pass
                     self._queue.clear()  # unacked queue: servers resend
                     self._open_next()
+                    # probe write: _open_next put 4 magic bytes on a
+                    # fresh file, proving the filesystem extends files
+                    # again; firing the write failpoint here makes an
+                    # armed ENOSPC storm hold the WAL down (degraded)
+                    # until the storm heals instead of letting reopen
+                    # "succeed" into the next failing batch
+                    faults.fire("wal.write", self.fault_scope)
                     self._last_idx = {}
                     self._failed = False
+                    self.failure_class = None
                 except OSError:
                     return False
             self._revive_thread_locked()

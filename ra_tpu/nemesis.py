@@ -43,17 +43,33 @@ from ra_tpu import faults, obs
 from ra_tpu.counters import NEMESIS_FIELDS
 
 # seeded disk-fault menu: every entry self-heals (one-shots disarm on
-# fire; node supervision / the harness infra check recovers the rest)
-DISK_FAULT_MENU: List[Tuple[str, Tuple, Tuple]] = [
-    ("wal.fsync", ("raise", "eio"), ("one_shot",)),
-    ("wal.write", ("torn", 0.5), ("one_shot",)),
-    ("wal.write", ("raise", "enospc"), ("one_shot",)),
-    ("wal.thread", ("crash",), ("one_shot",)),
-    ("segment_writer.thread", ("crash",), ("one_shot",)),
-    ("segment_writer.flush", ("raise", "eio"), ("one_shot",)),
-    ("meta.append", ("raise", "eio"), ("one_shot",)),
-    ("wal.fsync", ("latency", 0.02), ("one_shot", 2)),
+# fire; node supervision / the harness infra check recovers the rest).
+# Entries are (site, action, trigger, weight): weights skew the draw
+# per site so space-class faults (ENOSPC/EDQUOT — the storage-pressure
+# survival plane, docs/INTERNALS.md §21) fire often enough per soak to
+# exercise degraded-mode entry/exit without drowning out the integrity
+# class (EIO / torn / thread-crash) the restart paths need.
+DISK_FAULT_MENU: List[Tuple[str, Tuple, Tuple, int]] = [
+    ("wal.fsync", ("raise", "eio"), ("one_shot",), 2),
+    ("wal.write", ("torn", 0.5), ("one_shot",), 2),
+    ("wal.write", ("raise", "enospc"), ("one_shot",), 3),
+    ("wal.write", ("raise", "edquot"), ("one_shot",), 1),
+    ("wal.fsync", ("raise", "enospc"), ("one_shot",), 1),
+    ("wal.thread", ("crash",), ("one_shot",), 2),
+    ("segment_writer.thread", ("crash",), ("one_shot",), 2),
+    ("segment_writer.flush", ("raise", "eio"), ("one_shot",), 2),
+    ("meta.append", ("raise", "eio"), ("one_shot",), 2),
+    ("wal.fsync", ("latency", 0.02), ("one_shot", 2), 2),
 ]
+_DISK_MENU_WEIGHTS = [w for _, _, _, w in DISK_FAULT_MENU]
+
+
+def pick_disk_fault(rng: random.Random) -> Tuple[str, Tuple, Tuple]:
+    """One weighted menu draw (single rng consumption: random())."""
+    site, action, trigger, _w = rng.choices(
+        DISK_FAULT_MENU, weights=_DISK_MENU_WEIGHTS, k=1
+    )[0]
+    return site, action, trigger
 
 
 @dataclasses.dataclass
@@ -177,7 +193,7 @@ class DiskFaultDimension(Dimension):
         self.armed = 0
 
     def inject(self, ctx, rng):
-        site, action, trigger = rng.choice(DISK_FAULT_MENU)
+        site, action, trigger = pick_disk_fault(rng)
         faults.arm(site, action, trigger,
                    seed=rng.randrange(1 << 30),
                    scope=rng.choice(ctx.fault_scopes()))
@@ -193,6 +209,89 @@ class DiskFaultDimension(Dimension):
 
     def active(self):
         return self.armed > 0
+
+
+class DiskFullDimension(Dimension):
+    """ENOSPC storm: a PERSISTENT space-class failure against one
+    node's WAL (``("always",)`` trigger — every write, and every reopen
+    probe, keeps failing until heal). This is the storage-pressure
+    survival drill (docs/INTERNALS.md §21): the victim must flip to
+    ``storage_degraded`` (typed RA_NOSPACE rejects, elections/reads
+    still served), NOT restart-from-disk, and its probe loop must
+    auto-resume when the heal clears the storm. EDQUOT is drawn
+    occasionally: same class, different errno."""
+
+    name = "disk_full"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.storming = False
+
+    def inject(self, ctx, rng):
+        if self.storming:
+            return "heal", None
+        scope = rng.choice(ctx.fault_scopes())
+        which = "edquot" if rng.random() < 0.25 else "enospc"
+        faults.arm("wal.write", ("raise", which), ("always",),
+                   seed=rng.randrange(1 << 30), scope=scope)
+        self.storming = True
+        return "inject", f"{which} storm @ {scope or 'all'}"
+
+    def heal(self, ctx):
+        if self.storming:
+            self.storming = False
+            faults.disarm("wal.write")
+            return "storm cleared"
+        return None
+
+    def active(self):
+        return self.storming
+
+
+class SlowDiskDimension(Dimension):
+    """Slow-disk brownout: persistent fsync latency against one node's
+    WAL. The victim's li-smoothed fsync gauge must cross the brownout
+    threshold, shed its leaderships via transfer_leadership, and
+    un-mark once the latency clears (docs/INTERNALS.md §21)."""
+
+    name = "slow_disk"
+
+    # brownout detection needs a streak of slow ticks: a storm that
+    # heals on the very next roll lasts tens of milliseconds at harness
+    # op rates — below any sane detector window. Hold the storm for at
+    # least this many subsequent fires before a roll may heal it
+    # (deterministic: hold state is a pure function of the fire
+    # sequence, so schedules stay seed-replayable).
+    MIN_HOLD_FIRES = 8
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.slowed = False
+        self._held = 0
+
+    def inject(self, ctx, rng):
+        if self.slowed:
+            self._held += 1
+            if self._held < self.MIN_HOLD_FIRES:
+                return "skip", None
+            return "heal", None
+        scope = rng.choice(ctx.fault_scopes())
+        delay = rng.choice((0.02, 0.05))
+        faults.arm("wal.fsync", ("latency", delay), ("always",),
+                   seed=rng.randrange(1 << 30), scope=scope)
+        self.slowed = True
+        self._held = 0
+        return "inject", f"fsync +{delay * 1000:.0f}ms @ {scope or 'all'}"
+
+    def heal(self, ctx):
+        if self.slowed:
+            self.slowed = False
+            faults.disarm("wal.fsync")
+            return "latency cleared"
+        return None
+
+    def active(self):
+        return self.slowed
 
 
 class CrashRestartDimension(Dimension):
@@ -290,8 +389,11 @@ class ModeFlipDimension(Dimension):
 # network dimensions heal together (one unblock_all clears every block)
 _NET_DIMS = ("partition", "oneway")
 # dimensions cleared by the periodic transient heal (the legacy
-# ``kv_harness.heal()`` scope: network blocks + armed failpoints)
-_TRANSIENT_DIMS = _NET_DIMS + ("disk",)
+# ``kv_harness.heal()`` scope: network blocks + armed failpoints).
+# disk_full/slow_disk ride it too: their storms are persistent
+# ("always" triggers), so the periodic heal is what bounds each
+# degraded/brownout episode's length.
+_TRANSIENT_DIMS = _NET_DIMS + ("disk", "disk_full", "slow_disk")
 
 
 class Planner:
@@ -439,6 +541,8 @@ def standard_dimensions(
     partitions: bool = True,
     oneway: bool = False,
     disk_faults: bool = False,
+    disk_full: bool = False,
+    slow_disk: bool = False,
     restarts: bool = False,
     membership: bool = False,
     overload: bool = False,
@@ -453,6 +557,10 @@ def standard_dimensions(
         dims.append(OneWayPartitionDimension())
     if disk_faults:
         dims.append(DiskFaultDimension())
+    if disk_full:
+        dims.append(DiskFullDimension())
+    if slow_disk:
+        dims.append(SlowDiskDimension())
     if restarts:
         dims.append(CrashRestartDimension())
     if membership:

@@ -270,6 +270,12 @@ class InstallSnapshotAck:
 
     term: int
     chunk_no: int
+    # receiver-paced flow control (docs/INTERNALS.md §21): how many
+    # further chunks the receiver is prepared to accept beyond
+    # ``chunk_no``. Storage-blocked receivers grant 0 (the sender backs
+    # off and retries instead of spooling onto a full disk). Default 1
+    # keeps old-format acks (and pickled peers) on stop-and-wait.
+    credits: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -322,6 +328,14 @@ LOSSY_PROTOCOL_TYPES = frozenset((
 # sleeping a fixed backoff. The gate is process-local (never pickled:
 # rejects are generated by the node the client called).
 REJECT_OVERLOADED = ("reject", "overloaded")
+
+# Storage-degraded admission reject (docs/INTERNALS.md §21): the node's
+# WAL hit a space-class failure (ENOSPC/EDQUOT) or the hard disk
+# watermark pre-empted admission. Same shape and gate semantics as
+# REJECT_OVERLOADED — the third element's Event opens when the probe
+# write succeeds (or the watermark clears), so parked clients resume
+# the moment storage recovers.
+REJECT_NOSPACE = ("reject", "nospace")
 
 
 # -- events delivered to the server core (non-peer messages) ---------------

@@ -85,6 +85,7 @@ from ra_tpu.protocol import (
     RC_CMDS,
     RC_CMDS_LOW,
     RC_MSG,
+    REJECT_NOSPACE,
     REJECT_OVERLOADED,
     PreVoteResult,
     PreVoteRpc,
@@ -360,6 +361,14 @@ class BatchCoordinator:
         self._health = _health.register(
             node_name, backend="tpu_batch", capacity=max(64, capacity)
         )
+        # storage-pressure plane (docs/INTERNALS.md §21): the harness /
+        # embedding application drives enter/exit from its WAL-failure
+        # classification and watermark accounting; the coordinator
+        # consults it at admission and when granting snapshot credits
+        from ra_tpu.pressure import StoragePressure
+
+        self.pressure = StoragePressure(node_name)
+        self.snapshot_credit_window = 4
         self._hslots: List[int] = []  # gid -> scanner slot
         # commit-latency sampling mask: groups with gid & mask == 0 are
         # eligible (bounds hot-path cost to ~1/64 of groups); _lat_gids
@@ -878,6 +887,7 @@ class BatchCoordinator:
 
         _counters.delete(("coordinator", self.name))
         _health.unregister(self.name)
+        self.pressure.delete()
         for g in self.groups:
             if g is not None:
                 for t in g.machine_timers.values():
@@ -2162,6 +2172,39 @@ class BatchCoordinator:
         # missing applied notification — reference pipeline_command
         # semantics). Machine-INTERNAL commands (timer fires, Append
         # effects) fire exactly once with no retry path: never shed.
+        if self.pressure.blocked():
+            # storage-degraded pre-emption (docs/INTERNALS.md §21):
+            # space-class WAL failure or hard disk watermark. Client
+            # commands reject typed ("reject", "nospace") with the
+            # pressure gate's waiter (opens when the probe write
+            # succeeds); machine-internal commands still admit — they
+            # fire exactly once with no retry path.
+            admit2 = [c for c in cmds if c.internal]
+            shed2 = [c for c in cmds if not c.internal]
+            n_rej2 = 0
+            for cmd in shed2:
+                if cmd.from_ref is not None:
+                    n_rej2 += 1
+                    self._reply(
+                        cmd.from_ref,
+                        REJECT_NOSPACE + (self.pressure.waiter(),),
+                    )
+            if n_rej2:
+                self.counters.incr("commands_rejected_nospace", n_rej2)
+            if len(shed2) > n_rej2:
+                self.counters.incr(
+                    "commands_dropped_overload", len(shed2) - n_rej2
+                )
+            if shed2:
+                self._obs_rec.record(
+                    "admission_reject", node=self.name, group=g.name,
+                    term=term,
+                    detail=(f"nospace rejected={n_rej2} "
+                            f"dropped={len(shed2) - n_rej2}"),
+                )
+            cmds = admit2
+            if not cmds:
+                return
         room = self.max_command_backlog - (first - 1 - g.last_applied)
         if room < len(cmds):
             admit: List[Command] = []
@@ -4199,6 +4242,19 @@ class BatchCoordinator:
 
     # -- snapshot transfer (batch-backed groups) ---------------------------
 
+    def _snap_ack(self, g: GroupHost, chunk_no: int) -> InstallSnapshotAck:
+        """Chunk ack with receiver-paced credits (docs/INTERNALS.md
+        §21): 0 while this node is storage-blocked, so the sender parks
+        instead of streaming chunks at a disk that cannot spool them."""
+        window = max(1, self.snapshot_credit_window)
+        credits = self.pressure.snapshot_credits(window)
+        if credits:
+            self.counters.incr("snapshot_credits_granted", credits)
+        else:
+            self.counters.incr("snapshot_credit_waits")
+        self.counters.put("snapshot_credit_window", credits)
+        return InstallSnapshotAck(g.term, chunk_no, credits)
+
     def _receive_snapshot_chunk(self, g: GroupHost, msg: InstallSnapshotRpc, from_sid):
         """Host-side 4-phase chunked install; the device learns the new
         floor via a record_snapshot scatter on completion."""
@@ -4228,7 +4284,7 @@ class BatchCoordinator:
                 "meta": msg.meta, "chunks": [], "next": 1,
                 "accept": g.log.begin_accept_snapshot(msg.meta),
             }
-            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            send_one(self._snap_ack(g, msg.chunk_no))
             return
         acc = g.snap_accept
         if acc is None or acc["meta"].index != msg.meta.index:
@@ -4238,10 +4294,10 @@ class BatchCoordinator:
             for e in msg.data:
                 if g.log.fetch_term(e.index) is None:
                     g.log.write_sparse(e)
-            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            send_one(self._snap_ack(g, msg.chunk_no))
             return
         if msg.chunk_no < acc["next"]:
-            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            send_one(self._snap_ack(g, msg.chunk_no))
             return
         if msg.chunk_no > acc["next"]:
             return
@@ -4257,7 +4313,7 @@ class BatchCoordinator:
             acc["chunks"].append(msg.data)
         acc["next"] += 1
         if msg.chunk_phase != CHUNK_LAST:
-            send_one(InstallSnapshotAck(g.term, msg.chunk_no))
+            send_one(self._snap_ack(g, msg.chunk_no))
             return
         # complete: install host-side, then scatter the floor to device
         from ra_tpu.log.snapshot import decode_snapshot_chunks
@@ -4338,7 +4394,13 @@ class BatchCoordinator:
             self.transport = coord.transport
             self.snapshot_ack_timeout_s = 60.0
             self.server = type(
-                "S", (), {"id": (g.name, coord.name)}
+                "S", (),
+                {"id": (g.name, coord.name),
+                 # the sender counts credit starvation through the
+                 # server surface; route it to coordinator counters
+                 "_c": staticmethod(
+                     lambda field, n=1: coord.counters.incr(field, n)
+                 )},
             )()
 
         def enqueue(self, msg, front: bool = False):

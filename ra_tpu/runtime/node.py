@@ -146,6 +146,32 @@ class RaNode:
         # the window overflows, infra_down latches and healing stops
         self.infra_down = False
         self._infra_restarts: deque = deque()
+        # storage-pressure survival plane (docs/INTERNALS.md §21):
+        # degraded/hard admission state, byte watermarks, slow-disk
+        # brownout — all ticked from the detector loop below
+        from ra_tpu.pressure import (
+            BrownoutDetector,
+            DiskWatermark,
+            StoragePressure,
+        )
+
+        self.pressure = StoragePressure(name)
+        self._watermark = DiskWatermark(
+            soft_bytes=self.config.disk_soft_limit_bytes,
+            hard_bytes=self.config.disk_hard_limit_bytes,
+        )
+        self._brownout = BrownoutDetector(
+            enter_us=self.config.brownout_enter_us,
+            exit_us=self.config.brownout_exit_us,
+            streak=self.config.brownout_streak,
+        )
+        self.pressure.counter.put(
+            "disk_soft_limit_bytes", self.config.disk_soft_limit_bytes)
+        self.pressure.counter.put(
+            "disk_hard_limit_bytes", self.config.disk_hard_limit_bytes)
+        self._last_disk_check = 0.0
+        self._reclaim_baseline: Optional[int] = None
+        self._shed_busy = False
         from ra_tpu import health as ra_health
         from ra_tpu.detector import PhiAccrualDetector
 
@@ -299,6 +325,10 @@ class RaNode:
                 lease_drift_epsilon_s=extra.get(
                     "lease_drift_epsilon_s", 0.002
                 ),
+                # storage-pressure plane (docs/INTERNALS.md §21): every
+                # server on this node shares the node's pressure gate
+                pressure=self.pressure,
+                snapshot_credit_window=self.config.snapshot_credit_window,
             )
             server = Server(cfg, log, self.meta)
             server.recover()
@@ -481,14 +511,29 @@ class RaNode:
         """The shared WAL failed (I/O error or dead writer thread): put
         every server into await_condition, then restart the WAL on a
         fresh file with backoff (the supervision analog; on success
-        servers get wal_up and resend their unwritten tails)."""
+        servers get wal_up and resend their unwritten tails).
+
+        Space-class failures (ENOSPC/EDQUOT — docs/INTERNALS.md §21)
+        take the storage_degraded branch instead: same wal_down fan-out
+        (entries park in memtables, unacked), but admission flips to
+        typed RA_NOSPACE rejects, emergency reclamation runs, and a
+        probe-write loop — NOT the supervision intensity budget —
+        brings the node back when space returns. Raft control traffic
+        (heartbeats, elections, lease reads) needs no new disk and
+        keeps running throughout.
+        """
         # NO dedup guard here: every failure episode must get a healer
         # (Wal._fail one-shots per episode; the supervisor only fires on
         # a dead thread while not failed). A duplicate cycle costs a
         # redundant wal_down/wal_up round, which servers tolerate; a
         # DROPPED episode would wedge the node forever.
+        from ra_tpu.pressure import CLASS_SPACE, classify_storage_error
+
         for proc in list(self.procs.values()):
             proc.enqueue(LogEvent(("wal_down",)))
+        if classify_storage_error(exc) == CLASS_SPACE and self.wal.degraded:
+            self._enter_storage_degraded(exc)
+            return
         throttled = not self._note_infra_restart()
 
         def restart():
@@ -514,6 +559,154 @@ class RaNode:
         threading.Thread(
             target=restart, name=f"ra-wal-restart-{self.name}", daemon=True
         ).start()
+
+    def _enter_storage_degraded(self, exc: BaseException) -> None:
+        """Space-class WAL failure: degrade instead of restart. The
+        degraded episode deliberately does NOT consume the supervision
+        intensity budget — running out of disk repeatedly is expected
+        under pressure and is not the restart-churn shape the intensity
+        latch protects against."""
+        if not self.pressure.enter_degraded(
+            detail=f"{type(exc).__name__}: {exc}"
+        ):
+            return  # an earlier space episode already owns the probe loop
+        # reclaim first: the probe only succeeds once bytes come back
+        self._trigger_reclaim("storage_degraded")
+
+        def probe():
+            import time as _t
+
+            delay = 0.05
+            while self.running:
+                self.pressure.counter.incr("disk_probe_attempts")
+                if self.wal.reopen():
+                    # probe write succeeded (fresh file + magic bytes):
+                    # space is back. Wake parked RA_NOSPACE clients,
+                    # then resend the memtable tails.
+                    self.pressure.exit_degraded()
+                    for proc in list(self.procs.values()):
+                        proc.enqueue(LogEvent(("wal_up",)))
+                    return
+                _t.sleep(delay)
+                delay = min(delay * 2, 5.0)
+
+        threading.Thread(
+            target=probe, name=f"ra-wal-probe-{self.name}", daemon=True
+        ).start()
+
+    def _trigger_reclaim(self, why: str) -> None:
+        """Kick one emergency reclamation pass (docs/INTERNALS.md §21):
+        every server force-snapshots at its applied index (bypassing
+        min_snapshot_interval), advances its release cursor machinery,
+        and major-compacts — on its own actor thread, through the
+        existing log seams. Freed bytes are accounted on the next
+        watermark check against the baseline captured here."""
+        from ra_tpu import obs
+        from ra_tpu.pressure import dir_bytes
+
+        c = self.pressure.counter
+        c.incr("disk_reclaims")
+        if self._reclaim_baseline is None:
+            self._reclaim_baseline = dir_bytes(self.dir)
+        obs.flight_recorder().record(
+            "disk_reclaim", node=self.name, detail=why)
+        for proc in list(self.procs.values()):
+            proc.enqueue(("reclaim_storage",))
+
+    def _tick_storage(self, now: float) -> None:
+        """Watermark + brownout controller tick (detector thread)."""
+        if now - self._last_disk_check < self.config.disk_check_interval_s:
+            return
+        self._last_disk_check = now
+        from ra_tpu import obs
+        from ra_tpu.pressure import dir_bytes
+
+        c = self.pressure.counter
+        rec = obs.flight_recorder()
+        used = dir_bytes(self.dir)
+        c.put("disk_used_bytes", used)
+        if self._reclaim_baseline is not None:
+            if used < self._reclaim_baseline:
+                c.incr("disk_reclaimed_bytes", self._reclaim_baseline - used)
+            self._reclaim_baseline = None
+        for ev in self._watermark.tick(used):
+            if ev == "soft_enter":
+                c.incr("disk_soft_trips")
+            elif ev == "hard_enter":
+                c.incr("disk_hard_trips")
+                self.pressure.set_hard(True)
+            elif ev == "hard_exit":
+                self.pressure.set_hard(False)
+            rec.record("disk_pressure", node=self.name,
+                       detail=f"{ev} used={used}")
+        c.put("disk_pressure_state", self._watermark.state)
+        self._health.note_disk_pressure(self._watermark.state)
+        if self._watermark.soft:
+            # reclaim every check while over the soft line: each pass
+            # may free more (new applied entries -> higher snapshot)
+            self._trigger_reclaim("soft_watermark")
+        # slow-disk brownout: difference the WAL's cumulative fsync
+        # counters into a mean-latency sample for the detector
+        wc = self.wal.counter
+        evs = self._brownout.sample(
+            wc.get("fsyncs"), wc.get("fsync_time_us"))
+        c.put("brownout_fsync_us", int(self._brownout.smoothed_us))
+        for ev in evs:
+            if ev == "enter":
+                self.pressure.brownout = True
+                c.incr("brownout_entered")
+                c.put("brownout_active", 1)
+                rec.record(
+                    "brownout", node=self.name,
+                    detail=f"enter fsync_us={int(self._brownout.smoothed_us)}",
+                )
+            else:
+                self.pressure.brownout = False
+                c.incr("brownout_exited")
+                c.put("brownout_active", 0)
+                rec.record("brownout", node=self.name, detail="exit")
+        if self.pressure.brownout:
+            # attempted every tick while the episode lasts: the first
+            # transfer routinely loses to a not-yet-caught-up target
+            # (transfer_leadership demands a confirmed match_index)
+            self._shed_leaderships()
+
+    def _shed_leaderships(self) -> None:
+        """Browned out: hand every led group to a live peer. The
+        transfer blocks on a future, so it runs off the detector
+        thread; failures are fine — the next brownout tick retries
+        while the episode lasts."""
+        from ra_tpu.server import LEADER
+
+        if self._shed_busy:
+            return
+        for name, proc in list(self.procs.items()):
+            srv = proc.server
+            if srv.role != LEADER:
+                continue
+            targets = [
+                m for m in srv.members()
+                if m != srv.id and self.transport.proc_alive(m)
+            ]
+            if not targets:
+                continue
+            self.pressure.counter.incr("brownout_sheds")
+
+            self._shed_busy = True
+
+            def xfer(sid=srv.id, to=targets[0]):
+                from ra_tpu import api
+
+                try:
+                    api.transfer_leadership(sid, to, timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+                finally:
+                    self._shed_busy = False
+
+            threading.Thread(
+                target=xfer, name=f"ra-brownout-shed-{name}", daemon=True
+            ).start()
 
     def recover_registered(self) -> None:
         """server_recovery_strategy=registered: restart every registered
@@ -753,6 +946,7 @@ class RaNode:
                     last_health = _now_h
                     self._health_sweep(_now_h)
                     self.detector.publish()
+                self._tick_storage(_now_h)
                 # include previously-seen names: a stopped node
                 # unregisters, and its disappearance must read as death
                 known = set(self.transport.known_nodes()) | set(self._node_status)
@@ -843,6 +1037,9 @@ class RaNode:
             "wal": self.wal.overview(),
             "infra_down": self.infra_down,
             "infra_restarts_in_window": len(self._infra_restarts),
+            "storage_degraded": self.pressure.degraded,
+            "disk_pressure_state": self._watermark.state,
+            "brownout": self.pressure.brownout,
         }
 
     def stop(self) -> None:
@@ -850,6 +1047,7 @@ class RaNode:
         from ra_tpu import health as ra_health
 
         ra_health.unregister(self.name)
+        self.pressure.delete()
         # the detect loop publishes phi gauges: join it BEFORE closing
         # the detector, or an in-flight publish() re-registers the
         # gauge vectors close() just deleted (registry ghost)

@@ -91,6 +91,11 @@ class SnapshotSender:
         self.term = term
         self.acks: "threading.Condition" = threading.Condition()
         self.last_ack: int = -1
+        # receiver-paced credit window (docs/INTERNALS.md §21): highest
+        # chunk_no the receiver has authorized = last ack's chunk_no +
+        # its granted credits. Old-format acks default credits=1, which
+        # reproduces stop-and-wait exactly.
+        self.window_until: int = 0
         self.result: Optional[InstallSnapshotResult] = None
         self.thread = threading.Thread(
             target=self._run, name=f"ra-snap-send-{to[0]}", daemon=True
@@ -102,6 +107,8 @@ class SnapshotSender:
     def on_ack(self, ack: InstallSnapshotAck) -> None:
         with self.acks:
             self.last_ack = max(self.last_ack, ack.chunk_no)
+            credits = max(0, getattr(ack, "credits", 1))
+            self.window_until = max(self.window_until, ack.chunk_no + credits)
             self.acks.notify()
 
     def on_result(self, res: InstallSnapshotResult) -> None:
@@ -125,6 +132,34 @@ class SnapshotSender:
                 if left <= 0:
                     return "timeout"
                 self.acks.wait(timeout=left)
+
+    def _acquire_credit(self, no: int, timeout: float, send) -> str:
+        """Block until the receiver's credit window covers chunk ``no``
+        -> "ok" | "result" | "timeout". Credits ride acks, and a
+        storage-blocked receiver grants 0 — with no chunks in flight it
+        would never ack again, so starvation is probed by re-sending an
+        already-acked chunk number (a duplicate the receiver re-acks
+        with its CURRENT grant, without appending). Starvation past the
+        ack timeout fails the transfer into the existing
+        backoff-and-retry machinery (docs/INTERNALS.md §21)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self.acks:
+                if self.result is not None:
+                    return "result"
+                if self.window_until >= no:
+                    return "ok"
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return "timeout"
+                starved = not self.acks.wait(timeout=min(0.5, left))
+                probe_no = self.last_ack
+            if starved and probe_no >= 0:
+                # outside the lock: transports may deliver inline
+                count = getattr(self.proc.server, "_c", None)
+                if count is not None:
+                    count("snapshot_credit_waits")
+                send(probe_no, CHUNK_NEXT)
 
     def _run(self) -> None:
         proc = self.proc
@@ -175,15 +210,20 @@ class SnapshotSender:
                 if finish_on(self._await_ack(no, timeout)):
                     return
                 no += 1
-            # one-chunk lookahead tags the final chunk CHUNK_LAST while
-            # holding at most two chunks in memory
+            # body chunks stream under the receiver-granted credit
+            # window (in-flight <= credits; old acks grant 1, which IS
+            # stop-and-wait) — a one-chunk lookahead tags the final
+            # chunk CHUNK_LAST while holding at most two chunks in
+            # memory
             pending = next(chunk_src, b"")
             for chunk in chunk_src:
-                send(no, CHUNK_NEXT, pending)
-                if finish_on(self._await_ack(no, timeout)):
+                if finish_on(self._acquire_credit(no, timeout, send)):
                     return
+                send(no, CHUNK_NEXT, pending)
                 no += 1
                 pending = chunk
+            if finish_on(self._acquire_credit(no, timeout, send)):
+                return
             send(no, CHUNK_LAST, pending)
             # final result arrives as InstallSnapshotResult; wait for it
             deadline = time.monotonic() + timeout
@@ -310,6 +350,9 @@ class ServerProc:
                 "snapshot_send_failed",
             ):
                 effects = self._handle_sender_event(msg)
+            elif isinstance(msg, tuple) and msg and msg[0] == "reclaim_storage":
+                self._reclaim_storage()
+                effects = []
             elif isinstance(msg, tuple) and msg and msg[0] in (
                 "local_query",
                 "leader_query",
@@ -419,6 +462,33 @@ class ServerProc:
 
             self._stale_h = _obs.staleness_hist(self.server.id[1])
         return self._stale_h
+
+    def _reclaim_storage(self) -> None:
+        """Emergency reclamation on the owning thread (storage-pressure
+        plane, docs/INTERNALS.md §21): force a machine snapshot at the
+        applied index — bypassing min_snapshot_interval — which
+        truncates memtables, retires segments, prunes superseded
+        snapshots/checkpoints, and schedules minor-driven compaction;
+        then run one explicit major compaction pass. Best-effort: a
+        snapshot write that itself hits ENOSPC leaves the log exactly
+        as it was."""
+        srv = self.server
+        try:
+            idx = srv.last_applied
+            snap = srv.log.snapshot_index_term()
+            if idx > (snap[0] if snap else 0):
+                mac = srv.machine.which_module(srv.effective_machine_version)
+                srv.log.force_snapshot(
+                    idx, tuple(srv.members()), srv.effective_machine_version,
+                    srv.machine_state,
+                    live_indexes=tuple(mac.live_indexes(srv.machine_state)),
+                )
+                if srv.log.snapshot_index_term() != snap:
+                    srv._c("snapshots_written")
+                    srv._c("releases")
+            srv.log.major_compaction()
+        except Exception:  # noqa: BLE001 — reclamation must never kill
+            pass  # the proc; the watermark tick just retries
 
     def _handle_sender_event(self, msg) -> List[fx.Effect]:
         if msg[0] == "snapshot_send_done":

@@ -78,6 +78,7 @@ from ra_tpu.protocol import (
     InstallSnapshotRpc,
     LogEvent,
     NOOP,
+    REJECT_NOSPACE,
     REJECT_OVERLOADED,
     NodeEvent,
     PreVoteResult,
@@ -250,6 +251,15 @@ class ServerConfig:
     election_timeout_s: float = 0.15
     lease_safety_factor: float = 0.8
     lease_drift_epsilon_s: float = 0.002
+    # node-scope storage-pressure plane (ra_tpu.pressure.StoragePressure
+    # or None): when blocked() — WAL space-degraded or hard watermark —
+    # client commands reject ("reject", "nospace") through the same
+    # gate-waiter path as overload, and snapshot-chunk acks grant 0
+    # credits so inbound transfers pause (docs/INTERNALS.md §21).
+    pressure: Optional[Any] = None
+    # receiver-paced snapshot chunk credit window granted per ack while
+    # storage is healthy (SystemConfig.snapshot_credit_window)
+    snapshot_credit_window: int = 4
 
 
 class Server:
@@ -861,6 +871,26 @@ class Server:
         (fired exactly once with no retry path, e.g. monitor
         down/nodedown events)."""
         if cmd.kind != NOOP and not exempt and not cmd.internal:
+            # storage-degraded pre-emption (docs/INTERNALS.md §21):
+            # space-class WAL failure or hard disk watermark. Checked
+            # before the backlog window — a degraded node must not let
+            # clients consume backlog it cannot durably append. The
+            # waiter opens when the probe write succeeds.
+            pressure = self.cfg.pressure
+            if pressure is not None and pressure.blocked():
+                if cmd.from_ref is not None:
+                    self._c("commands_rejected_nospace")
+                    effects.append(Reply(
+                        cmd.from_ref,
+                        REJECT_NOSPACE + (pressure.waiter(),),
+                    ))
+                else:
+                    self._c("commands_dropped_overload")
+                self._obs_rec.record(
+                    "admission_reject", node=self.id[1], group=self.id[0],
+                    term=self.current_term, detail="nospace",
+                )
+                return
             # admission window: bound the appended-but-unapplied backlog
             # (noops and machine-internal commands bypass — the commit
             # gate must never be starved, and timer fires / Append
@@ -2232,6 +2262,22 @@ class Server:
     # ------------------------------------------------------------------
     # receive_snapshot role
 
+    def _snap_ack(self, chunk_no: int) -> InstallSnapshotAck:
+        """Chunk ack with receiver-paced credits (docs/INTERNALS.md
+        §21): how many further chunks this receiver will accept. A
+        storage-blocked receiver grants 0 — the sender parks instead of
+        spooling chunks onto a disk that cannot hold them."""
+        pressure = self.cfg.pressure
+        window = max(1, self.cfg.snapshot_credit_window)
+        credits = (window if pressure is None
+                   else pressure.snapshot_credits(window))
+        if credits:
+            self._c("snapshot_credits_granted", credits)
+        else:
+            self._c("snapshot_credit_waits")
+        self._g("snapshot_credit_window", credits)
+        return InstallSnapshotAck(self.current_term, chunk_no, credits)
+
     def _handle_receive_snapshot(self, msg: Any, from_peer: Optional[ServerId]) -> EffectList:
         """Four-phase chunked snapshot install: init -> pre (sparse live
         entries) -> next* -> last (reference: handle_receive_snapshot
@@ -2258,7 +2304,7 @@ class Server:
                     "accept": self.log.begin_accept_snapshot(msg.meta),
                 }
                 effects.append(
-                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
+                    SendRpc(from_peer, self._snap_ack(msg.chunk_no))
                 )
                 return effects
             acc = self._snap_accept
@@ -2273,7 +2319,7 @@ class Server:
                     if self.log.fetch_term(e.index) is None:
                         self.log.write_sparse(e)
                 effects.append(
-                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
+                    SendRpc(from_peer, self._snap_ack(msg.chunk_no))
                 )
                 return effects
             # next / last: validate chunk ordering — duplicates (sender
@@ -2281,7 +2327,7 @@ class Server:
             # future chunks are ignored so the sender retries in order
             if msg.chunk_no < acc["next_chunk"]:
                 effects.append(
-                    SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
+                    SendRpc(from_peer, self._snap_ack(msg.chunk_no))
                 )
                 return effects
             if msg.chunk_no > acc["next_chunk"]:
@@ -2301,7 +2347,7 @@ class Server:
             if msg.chunk_phase == CHUNK_LAST:
                 return self._complete_snapshot(msg, from_peer, effects)
             effects.append(
-                SendRpc(from_peer, InstallSnapshotAck(self.current_term, msg.chunk_no))
+                SendRpc(from_peer, self._snap_ack(msg.chunk_no))
             )
             return effects
         if isinstance(msg, ElectionTimeout):

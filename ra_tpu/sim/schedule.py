@@ -83,6 +83,11 @@ class Schedule:
     # lease drift epsilon is widened to cover
     lease: bool = False
     skew_ppm: int = 0
+    # storage-pressure plane (docs/INTERNALS.md §21): a per-node disk
+    # byte budget (0 = unlimited). Writes that would exceed it fail
+    # space-class: the node parks them (degraded) until the horizon
+    # heal frees space — acked writes must survive the episode
+    disk_budget_bytes: int = 0
     ops: Optional[Tuple[Op, ...]] = None  # explicit timeline overrides n_ops
 
     def with_ops(self, ops: List[Op]) -> "Schedule":
@@ -105,7 +110,8 @@ def dumps(sched: Schedule) -> str:
         f"horizon_ms={sched.horizon_ms} settle_ms={sched.settle_ms}",
         f"drop_p={sched.drop_p} dup_p={sched.dup_p} delay_p={sched.delay_p}"
         f" delay_ms_max={sched.delay_ms_max} nemesis={sched.nemesis}",
-        f"lease={sched.lease} skew_ppm={sched.skew_ppm}",
+        f"lease={sched.lease} skew_ppm={sched.skew_ppm}"
+        f" disk_budget_bytes={sched.disk_budget_bytes}",
     ]
     for t_ms, op in sched.resolve_ops():
         lines.append(f"{t_ms} {op!r}")
@@ -139,5 +145,6 @@ def loads(text: str) -> Schedule:
         nemesis=head.get("nemesis", "False") == "True",
         lease=head.get("lease", "False") == "True",
         skew_ppm=int(head.get("skew_ppm", 0)),
+        disk_budget_bytes=int(head.get("disk_budget_bytes", 0)),
         ops=tuple(ops),
     )

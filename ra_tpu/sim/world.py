@@ -78,6 +78,15 @@ from ra_tpu.sim.transport import SimNetwork
 from ra_tpu.sim.workloads import invariant_for, make_machine
 
 
+# Planted misclassification bug (docs/INTERNALS.md §21, exercised by
+# tests/test_sim.py): treat a space-class write failure like a torn
+# frame — poison the node and let "recovery" truncate the durable
+# tail. Since every replica runs the same byte accounting over the
+# same log, they all truncate the same committed (acked) entry, and
+# the acked-writes-survive oracle fires deterministically.
+SIM_BUG_SPACE_AS_POISON = False
+
+
 def _fp(state: Any) -> str:
     """Stable state fingerprint. Pickle is deterministic here because
     the sim itself is: both runs build identical structures in
@@ -161,6 +170,12 @@ class SimNode:
         self.machine_timers: Dict[Any, int] = {}
         self.snap_retry: Dict[ServerId, int] = {}
         self.senders: Dict[ServerId, Dict[str, Any]] = {}
+        # disk-space model (schedule.disk_budget_bytes): deterministic
+        # byte accounting over durable writes; exhausted writes park
+        # until disk_heal (the sim storage_degraded episode)
+        self.disk_used = 0
+        self.space_degraded = False
+        self._parked: List[Any] = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -221,8 +236,19 @@ class SimNode:
     def boot(self) -> None:
         # clean-crash model: everything appended had its write
         # completion flushed before the rebuild (torn-tail crashes are
-        # the disk-fault soak lane's job, not the sim's)
+        # the disk-fault soak lane's job, not the sim's) — unless the
+        # disk is exhausted, in which case those writes stay parked:
+        # they were never durable and must not confirm across a reboot
+        w0 = self.world
         for evt in self.log.pending_written_events():
+            if w0.disk_budget and not SIM_BUG_SPACE_AS_POISON:
+                cost = self._evt_bytes(evt)
+                if (self.space_degraded
+                        or self.disk_used + cost > w0.disk_budget):
+                    self.space_degraded = True
+                    self._parked.append(evt)
+                    continue
+                self.disk_used += cost
             self.log.handle_event(evt)
         self._build_server()
         self.server.recover()
@@ -302,9 +328,71 @@ class SimNode:
     def _flush_wal(self) -> None:
         """Write->written as a scheduled event: durability has latency
         and is schedulable (and therefore reorderable) like everything
-        else."""
+        else. Under a disk budget, writes that would exceed it fail
+        space-class: parked (never confirmed) until disk_heal — the
+        sim's storage_degraded episode."""
         w = self.world
         for evt in self.log.pending_written_events():
+            if w.disk_budget:
+                cost = self._evt_bytes(evt)
+                if self.space_degraded or self.disk_used + cost > w.disk_budget:
+                    if self._on_disk_full(evt) == "poisoned":
+                        return  # log truncated: remaining evts are stale
+                    continue
+                self.disk_used += cost
+
+            def deliver(evt=evt) -> None:
+                if self.running:
+                    w.trace("wal", w.clock.now_ms, self.name, evt[1],
+                            str(evt[2]))
+                    self.post(LogEvent(evt))
+
+            w.sched.after_ms(w.wal_ms, deliver)
+
+    def _evt_bytes(self, evt: Any) -> int:
+        """Deterministic frame cost of one ("written", term, seq) batch:
+        a fixed header plus the pickled command payload per entry —
+        identical across replicas because replicated logs are."""
+        cost = 0
+        for idx in evt[2]:
+            e = self.log.fetch(idx)
+            if e is not None:
+                cost += 32 + len(pickle.dumps(e.cmd))
+        return cost
+
+    def _on_disk_full(self, evt: Any) -> str:
+        w = self.world
+        if SIM_BUG_SPACE_AS_POISON:
+            # the misclassification under test: ENOSPC handled like a
+            # torn frame — poison-restart, and "recovery" truncates the
+            # durable tail (discarding a committed, possibly acked,
+            # entry). The clean path below provably never does this.
+            last, _t = self.log.last_written()
+            w.trace("disk_poison", w.clock.now_ms, self.name, last)
+            if last > 0:
+                self.log.set_last_index(last - 1)
+            self.crash()
+            self.boot()
+            return "poisoned"
+        if not self.space_degraded:
+            self.space_degraded = True
+            w.trace("disk_full", w.clock.now_ms, self.name, self.disk_used)
+            w.ctr.incr("sim_disk_exhaustions")
+        self._parked.append(evt)
+        w.ctr.incr("sim_disk_parked_writes")
+        return "parked"
+
+    def disk_heal(self) -> None:
+        """Operator freed space: exit degraded, confirm every parked
+        write (stale ones — overwritten since — are filtered by the
+        log's term check, exactly like late WAL notifications)."""
+        w = self.world
+        self.space_degraded = False
+        self.disk_used = 0
+        parked, self._parked = self._parked, []
+        if parked:
+            w.trace("disk_heal", w.clock.now_ms, self.name, len(parked))
+        for evt in parked:
             def deliver(evt=evt) -> None:
                 if self.running:
                     w.trace("wal", w.clock.now_ms, self.name, evt[1],
@@ -633,6 +721,11 @@ class SimWorld:
         self._acked_floor = -1
         self._read_floor: Dict[int, int] = {}
         self._seq_write_refs: Set[int] = set()
+        # acked-writes-survive oracle (§21): raft index -> state fp at
+        # the apply that was acked; any later apply at that index with
+        # a different fp means a confirmed write was destroyed
+        self._acked_fp: Dict[int, str] = {}
+        self.disk_budget = sched_in.disk_budget_bytes
         self._old_leader: Optional[str] = None
         self._session_ctr = (
             ra_counters.registry().new(("session", "sim"), SESSION_FIELDS)
@@ -716,6 +809,12 @@ class SimWorld:
                      pre: Any, post: Any, effs: Any) -> None:
         fp = _fp(post)
         self.trace("apply", self.clock.now_ms, node_name, index, fp[:8])
+        want = self._acked_fp.get(index)
+        if want is not None and fp != want:
+            self.violation(
+                f"acked write lost: index {index} re-applied on "
+                f"{node_name} as {fp}, acked state was {want}"
+            )
         mine = self.digests[node_name]
         prev = mine.get(index)
         if prev is not None and prev != fp:
@@ -772,6 +871,11 @@ class SimWorld:
                 idx = reply[1][1] if isinstance(reply[1], tuple) else -1
                 if idx > self._acked_floor:
                     self._acked_floor = idx
+                if idx >= 0 and idx not in self._acked_fp:
+                    for d in self.digests.values():
+                        if idx in d:
+                            self._acked_fp[idx] = d[idx]
+                            break
         elif kind == "rd":
             self.replies.setdefault(i, []).append(reply)
             floor = self._read_floor.pop(i, None)
@@ -898,6 +1002,10 @@ class SimWorld:
         for name in sorted(self.nodes):
             if not self.nodes[name].running:
                 self.nodes[name].boot()
+        for name in sorted(self.nodes):
+            node = self.nodes[name]
+            if node.space_degraded or node._parked:
+                node.disk_heal()
 
     # -- run ---------------------------------------------------------------------------
 
@@ -919,6 +1027,27 @@ class SimWorld:
             self.ctr.incr("sim_schedules_failed")
         self.ctr.incr("sim_steps_executed", self.steps)
         self.ctr.incr("sim_virtual_ms", self.clock.now_ms)
+        # acked-writes-survive oracle (§21), end-of-run form: after the
+        # horizon heal + settle, every surviving replica's state must
+        # reflect the highest acked seq write. A space failure handled
+        # as poison truncates the durable tail on every replica (same
+        # byte accounting, same log), and the acked index silently
+        # vanishes — invisible to the per-apply oracles because meta's
+        # last_applied stays above the truncated entry, so nothing ever
+        # re-applies at that index.
+        if self.disk_budget and self._acked_floor >= 0:
+            for name in sorted(self.nodes):
+                node = self.nodes[name]
+                if not node.running:
+                    continue
+                st = node.server.machine_state
+                got = st.get("seq") if isinstance(st, dict) else None
+                at = got[0] if got else -1
+                if at < self._acked_floor:
+                    self.violation(
+                        f"acked write lost on {name}: seq last written at "
+                        f"index {at} < acked floor {self._acked_floor}"
+                    )
         final = {
             name: (node.server.last_applied, _fp(node.server.machine_state))
             for name, node in self.nodes.items()

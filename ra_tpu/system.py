@@ -108,6 +108,24 @@ class SystemConfig:
     # is not healing; endless restarts would just churn)
     infra_restart_intensity: int = 5
     infra_restart_window_s: float = 10.0
+    # storage-pressure survival plane (docs/INTERNALS.md §21): byte
+    # watermarks over the node's data dir (WAL + segments + snapshots
+    # + accept spools). Soft triggers emergency reclamation (forced
+    # snapshots -> release cursors -> major compaction -> snapshot
+    # prunes) BEFORE ENOSPC fires; hard pre-empts client admission
+    # (typed RA_NOSPACE rejects). 0 = unlimited (watermarks off).
+    disk_soft_limit_bytes: int = 0
+    disk_hard_limit_bytes: int = 0
+    disk_check_interval_s: float = 1.0
+    # slow-disk brownout (li-smoothed mean WAL fsync latency, us):
+    # `streak` consecutive checks past enter sheds leaderships via
+    # transfer_leadership; the same streak under exit un-marks
+    brownout_enter_us: float = 200_000.0
+    brownout_exit_us: float = 50_000.0
+    brownout_streak: int = 3
+    # receiver-paced snapshot chunk credit window (flow-controlled
+    # snapshot streaming); receivers grant 0 while storage-blocked
+    snapshot_credit_window: int = 4
     # all: bump machine version when leader supports it; quorum: when a
     # quorum of members support it (reference: src/ra_server.erl:223-233).
     machine_upgrade_strategy: str = "all"

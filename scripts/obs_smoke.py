@@ -278,6 +278,28 @@ def main() -> int:
                 f"obs_smoke sim schedule failed: {_sim_res.violations[:1]}"
             )
 
+        # storage-pressure plane (docs/INTERNALS.md §21): drive one
+        # StoragePressure through a full degraded episode (credits must
+        # starve while degraded and restore on resume) plus watermark /
+        # brownout transitions so the ra_disk_* / ra_brownout_* families
+        # are present AND nonzero in the scrape. The snapshot credit
+        # families ride the live coordinator vectors — presence-gated,
+        # since no snapshot transfer runs inside a smoke burst.
+        from ra_tpu.pressure import StoragePressure as _SP
+
+        _sp = _SP("obs_smoke_disk")
+        _sp.enter_degraded(detail="obs_smoke")
+        if _sp.snapshot_credits(4) != 0:
+            errors.append("degraded pressure still grants snapshot credits")
+        _sp.exit_degraded()
+        if _sp.snapshot_credits(4) != 4:
+            errors.append("resumed pressure grants no snapshot credits")
+        _sp.counter.incr("disk_soft_trips")
+        _sp.counter.incr("disk_reclaims")
+        _sp.counter.put("disk_used_bytes", 123)
+        _sp.counter.incr("brownout_entered")
+        _sp.counter.incr("brownout_exited")
+
         text = api.prometheus_metrics()
         required_live = required_bench + [
             r"# TYPE ra_commit_rate gauge",
@@ -376,6 +398,36 @@ def main() -> int:
             r"# TYPE ra_read_lease_expirations counter",
             r"# TYPE ra_read_lease_revocations counter",
             r"# TYPE ra_read_stale_rejected counter",
+            # storage-pressure plane (docs/INTERNALS.md §21): the stub
+            # episode above must show up nonzero; the rest of the
+            # taxonomy gates on family presence
+            r"ra_disk_degraded_entered\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_disk_degraded_resumed\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_disk_soft_trips\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_disk_reclaims\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_disk_used_bytes\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_brownout_entered\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"ra_brownout_exited\{[^}]*obs_smoke_disk[^}]*\} (\d+)",
+            r"# TYPE ra_disk_hard_trips counter",
+            r"# TYPE ra_disk_pressure_state gauge",
+            r"# TYPE ra_disk_probe_attempts counter",
+            r"# TYPE ra_brownout_active gauge",
+            r"# TYPE ra_brownout_sheds counter",
+            r"# TYPE ra_space_failures counter",
+            r"# TYPE ra_commands_rejected_nospace counter",
+            r"# TYPE ra_health_disk_pressure gauge",
+            r"# TYPE ra_health_disk_transitions counter",
+            # snapshot credit flow control (§21): presence only — no
+            # transfer runs inside a smoke burst
+            r"# TYPE ra_snapshot_credits_granted counter",
+            r"# TYPE ra_snapshot_credit_waits counter",
+            r"# TYPE ra_snapshot_credit_window gauge",
+            # sim disk-space model (§21)
+            r"# TYPE ra_sim_disk_exhaustions counter",
+            r"# TYPE ra_sim_disk_parked_writes counter",
+            # nemesis disk-pressure dimensions
+            r"# TYPE ra_nemesis_disk_full_injected counter",
+            r"# TYPE ra_nemesis_slow_disk_injected counter",
         ]
         _check_exposition(text, errors, required_live)
 
@@ -431,6 +483,10 @@ def main() -> int:
             c.stop()
         for c in pipe_coords:
             c.stop()
+        try:
+            _sp.delete()
+        except Exception:  # noqa: BLE001
+            pass
         try:
             smoke_wal.close()
         except Exception:  # noqa: BLE001

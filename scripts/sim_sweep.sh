@@ -37,4 +37,33 @@ python -m pytest tests/test_sim.py -q -m sim \
 echo "== sim sweep: explorer, $N fresh seeds x kv/fifo/session/kvread =="
 python -m ra_tpu.sim.explorer --seeds "$N" --start "$SIM_SEED_BASE"
 
+echo "== sim sweep: disk-budget band (fresh seeds, kv + faults) =="
+# storage-pressure plane (docs/INTERNALS.md §21): the same kv schedules
+# under a per-node disk byte budget, from starved to roomy. Exhausted
+# nodes must park writes (space-class), heal at the horizon, and every
+# oracle — state divergence, replay divergence, acked-writes-survive —
+# must stay quiet. Failures auto-shrink like any other sim schedule.
+python - <<'EOF'
+import os, sys
+from ra_tpu.sim import Schedule, run_schedule, shrink
+
+base = int(os.environ["SIM_SEED_BASE"])
+fails = 0
+for seed in range(base, base + 8):
+    for budget in (600, 1500, 6000):
+        sched = Schedule(seed=seed, workload="kv",
+                         drop_p=0.02, dup_p=0.02, delay_p=0.15,
+                         disk_budget_bytes=budget)
+        r = run_schedule(sched)
+        if not r.ok:
+            fails += 1
+            minimized, replays = shrink(r.schedule)
+            print(f"disk-budget FAIL seed={seed} budget={budget}: "
+                  f"{r.violations[:3]}", file=sys.stderr)
+            from ra_tpu.sim import dumps
+            print(dumps(minimized), file=sys.stderr)
+print(f"disk-budget band: {24 - fails}/24 schedules clean")
+sys.exit(1 if fails else 0)
+EOF
+
 echo "sim sweep: PASS (SIM_SEED_BASE=$SIM_SEED_BASE)"

@@ -63,6 +63,26 @@ for seed in $(seq 200 $((199 + N))); do
     done
 done
 
+echo "== soak: disk-pressure dimension ($N fresh seeds per backend) =="
+# storage-pressure survival plane (docs/INTERNALS.md §21): ENOSPC/
+# EDQUOT storms and fsync-latency brownouts layered on the disk-fault
+# mix — space-class failures must degrade in place (typed RA_NOSPACE
+# rejects, reclaim, probe-loop auto-resume), never restart, and never
+# lose an acked write. Partitions/membership off: this lane isolates
+# the storage plane so a failure bisects to it directly.
+for seed in $(seq 300 $((299 + N))); do
+    for backend in per_group_actor tpu_batch; do
+        echo "-- seed=$seed backend=$backend disk-pressure"
+        python -m ra_tpu.kv_harness --seed "$seed" --ops 120 \
+            --backend "$backend" --disk-faults --disk-full --slow-disk \
+            --no-partitions --no-membership \
+            >/tmp/soak_run.log 2>&1 \
+            || { echo "soak FAILED: seed=$seed backend=$backend" \
+                      "disk-pressure"; \
+                 tail -60 /tmp/soak_run.log; exit 1; }
+    done
+done
+
 echo "== soak: consistent-read bench (lease vs quorum control) =="
 # smoke-scale read bench: the lease arm must beat the quorum-round
 # control — a regression to fallback-on-every-read fails the soak

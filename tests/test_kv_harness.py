@@ -209,3 +209,72 @@ def test_kv_harness_lease_reads_matrix(backend, seed):
     res = kv_harness.run(seed=seed, n_ops=100, backend=backend, lease=True)
     assert res.consistent, res.failures
     assert res.ops.get("get", 0) > 0
+
+
+# storage-pressure dimension (docs/INTERNALS.md §21): persistent
+# ENOSPC/EDQUOT storms (disk_full) must flip nodes into
+# storage_degraded — typed rejects, no restart, probe-loop resume —
+# and fsync-latency storms (slow_disk) must leave the run consistent.
+# The acceptance bar: zero lost acked writes across degrade -> reclaim
+# -> resume cycles, visible in the flight recorder.
+
+
+def _recorder_high_water():
+    from ra_tpu import obs
+
+    evs = obs.flight_recorder().events()
+    return evs[-1]["seq"] if evs else -1
+
+
+def _recorder_kinds_since(mark):
+    from ra_tpu import obs
+
+    return [e["kind"] for e in obs.flight_recorder().events()
+            if e["seq"] > mark]
+
+
+def test_kv_harness_disk_full_actor():
+    mark = _recorder_high_water()
+    res = kv_harness.run(seed=11, n_ops=120, backend="per_group_actor",
+                         partitions=False, membership=False, restarts=False,
+                         disk_full=True, op_timeout=3.0)
+    assert res.consistent, res.failures
+    assert res.ops.get("disk_full", 0) > 0, "no ENOSPC storms fired"
+    kinds = _recorder_kinds_since(mark)
+    # the survival loop actually cycled: degrade -> reclaim -> resume
+    assert "storage_degraded" in kinds
+    assert "disk_reclaim" in kinds
+    assert "storage_resumed" in kinds
+
+
+def test_kv_harness_disk_full_batch():
+    res = kv_harness.run(seed=11, n_ops=100, backend="tpu_batch",
+                         partitions=False, membership=False, restarts=False,
+                         disk_full=True, op_timeout=3.0)
+    assert res.consistent, res.failures
+    assert res.ops.get("disk_full", 0) > 0, "no ENOSPC storms fired"
+    assert res.ops.get("batch_degraded", 0) > 0, \
+        "coordinator never entered degraded mode"
+    assert res.ops.get("batch_resumed", 0) > 0, \
+        "coordinator never resumed from degraded mode"
+
+
+def test_kv_harness_slow_disk_actor():
+    res = kv_harness.run(seed=5, n_ops=100, backend="per_group_actor",
+                         partitions=False, membership=False, restarts=False,
+                         slow_disk=True, op_timeout=5.0)
+    assert res.consistent, res.failures
+    assert res.ops.get("slow_disk", 0) > 0, "no slow-disk storms fired"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["tpu_batch", "per_group_actor"])
+@pytest.mark.parametrize("seed", [71, 72, 73])
+def test_kv_harness_disk_pressure_matrix(backend, seed):
+    # acceptance matrix: ENOSPC + slow-disk storms on top of the disk
+    # fault mix, both backends, >= 3 seeds, still zero lost acked writes
+    res = kv_harness.run(seed=seed, n_ops=120, backend=backend,
+                         partitions=False, membership=False,
+                         disk_faults=True, disk_full=True, slow_disk=True,
+                         op_timeout=5.0)
+    assert res.consistent, res.failures

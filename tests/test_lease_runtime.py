@@ -295,7 +295,14 @@ def test_runtime_lease_reads_across_transfer(lease_cluster):
     leader = api.wait_for_leader("leased")
     api.process_command(ids[0], 10)
     target = next(sid for sid in ids if sid != leader)
+    # transfer_leadership refuses targets that are not provably caught
+    # up (match_index + 1 == next_index), and the chosen follower may
+    # not be in the commit quorum yet — retry until it catches up
+    deadline = time.monotonic() + 5
     out = api.transfer_leadership(leader, target)
+    while out[0] != "ok" and time.monotonic() < deadline:
+        time.sleep(0.05)
+        out = api.transfer_leadership(leader, target)
     assert out[0] == "ok", out
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:

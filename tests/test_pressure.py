@@ -1,0 +1,526 @@
+"""Storage-pressure survival plane (docs/INTERNALS.md §21).
+
+Covers the errno taxonomy (space vs integrity), the degraded-mode
+admission/probe/resume loop, the disk watermark controller, slow-disk
+brownout detection + leadership shed, snapshot credit flow control, and
+the native/Python ENOSPC classification parity (the native framer's
+``-(1000+errno)`` surface must land in the same class as the Python
+framer's OSError).
+"""
+
+import errno
+import os
+import pickle
+import random
+import time
+
+import pytest
+
+from ra_tpu import api, faults
+from ra_tpu.log.tables import TableRegistry
+from ra_tpu.log.wal import Wal
+from ra_tpu.pressure import (
+    CLASS_INTEGRITY,
+    CLASS_SPACE,
+    BrownoutDetector,
+    DiskWatermark,
+    StoragePressure,
+    classify_storage_error,
+    dir_bytes,
+)
+from ra_tpu.system import SystemConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.disarm_all()
+    yield
+    faults.disarm_all()
+
+
+class Sink:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, uid, evt):
+        self.events.append((uid, evt))
+
+
+def mk_wal(tmp_path, sink=None, tables=None, **kw):
+    return Wal(
+        str(tmp_path / "wal"),
+        tables or TableRegistry(),
+        sink or Sink(),
+        threaded=False,
+        sync_method="none",
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# errno taxonomy
+
+
+def test_classify_storage_error():
+    assert classify_storage_error(OSError(errno.ENOSPC, "x")) == CLASS_SPACE
+    assert classify_storage_error(OSError(errno.EDQUOT, "x")) == CLASS_SPACE
+    assert classify_storage_error(OSError(errno.EIO, "x")) == CLASS_INTEGRITY
+    assert classify_storage_error(OSError(errno.EBADF, "x")) == CLASS_INTEGRITY
+    # short write / torn frame surfaces as a bare exception: poison
+    assert classify_storage_error(ValueError("short write")) == CLASS_INTEGRITY
+    assert classify_storage_error(RuntimeError("boom")) == CLASS_INTEGRITY
+
+
+def test_wal_enospc_is_space_class_and_probe_resumes(tmp_path):
+    wal = mk_wal(tmp_path)
+    wal.write("u1", 1, 1, pickle.dumps("a"))
+    wal.flush()
+    faults.arm("wal.write", ("raise", "enospc"), ("always",), seed=1)
+    wal.write("u1", 2, 1, pickle.dumps("b"))
+    wal.flush()
+    assert wal.failed and wal.degraded
+    assert wal.failure_class == "space"
+    assert wal.counter.get("space_failures") == 1
+    # the probe seam: reopen() fires the write failpoint, so an armed
+    # storm holds the WAL down instead of letting reopen "succeed"
+    assert wal.reopen() is False
+    assert wal.degraded
+    faults.disarm("wal.write")
+    assert wal.reopen() is True
+    assert not wal.failed and wal.failure_class is None
+
+
+def test_wal_eio_is_integrity_class(tmp_path):
+    wal = mk_wal(tmp_path)
+    faults.arm("wal.write", ("raise", "eio"), ("one_shot",), seed=1)
+    wal.write("u1", 1, 1, pickle.dumps("a"))
+    wal.flush()
+    assert wal.failed and not wal.degraded
+    assert wal.failure_class == "integrity"
+    assert wal.counter.get("space_failures") == 0
+
+
+def test_wal_edquot_is_space_class(tmp_path):
+    wal = mk_wal(tmp_path)
+    faults.arm("wal.write", ("raise", "edquot"), ("one_shot",), seed=1)
+    wal.write("u1", 1, 1, pickle.dumps("a"))
+    wal.flush()
+    assert wal.degraded and wal.failure_class == "space"
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC mid-batch: clean durable prefix (Python and native framers)
+
+
+def test_enospc_mid_batch_clean_prefix_python(tmp_path):
+    """A batch that dies to ENOSPC after the kernel took a partial
+    write must leave a recoverable prefix: every fully-framed earlier
+    batch survives, the torn tail is discarded, nothing is corrupted."""
+    sink = Sink()
+    tables = TableRegistry()
+    wal = mk_wal(tmp_path, sink, tables)
+    for i in range(1, 4):
+        wal.write("u1", i, 1, pickle.dumps(f"v{i}"))
+    wal.flush()  # batch A fully durable
+    # emulate the kernel's short-write-then-ENOSPC: a prefix of batch
+    # B's frame bytes lands on disk, then the write call errors
+    frame_b = wal._frame(
+        [(1, wal._uid_refs["u1"], 4, 1, pickle.dumps("v4"))]
+    )
+    with open(wal._file_path, "ab") as f:
+        f.write(frame_b[: max(1, len(frame_b) // 2)])
+    faults.arm("wal.write", ("raise", "enospc"), ("always",), seed=1)
+    wal.write("u1", 4, 1, pickle.dumps("v4"))
+    wal.flush()
+    assert wal.degraded  # space class: provably-clean prefix
+    faults.disarm_all()
+    # recovery over the dirty file: batch A intact, torn tail dropped
+    tables2 = TableRegistry()
+    Wal(str(tmp_path / "wal"), tables2, Sink(), threaded=False,
+        sync_method="none")
+    mt = tables2.mem_table("u1")
+    assert [mt.get(i).cmd for i in (1, 2, 3)] == ["v1", "v2", "v3"]
+    assert mt.get(4) is None
+
+
+def test_enospc_mid_batch_clean_prefix_native(tmp_path):
+    """Same contract through the native wal_write_batch errno surface:
+    a real ENOSPC from the C++ write loop (driven against /dev/full)
+    must classify space and leave the earlier batches recoverable."""
+    from ra_tpu import native
+
+    if not native.available() or not os.path.exists("/dev/full"):
+        pytest.skip("native wal or /dev/full unavailable")
+    sink = Sink()
+    tables = TableRegistry()
+    wal = mk_wal(tmp_path, sink, tables)
+    if not wal._native:
+        pytest.skip("wal not running the native framer")
+    for i in range(1, 4):
+        wal.write("u1", i, 1, pickle.dumps(f"v{i}"))
+    wal.flush()  # batch A durable through the native path
+    assert wal.counter.get("native_batches") >= 1
+
+    class _FullShim:
+        """File shim steering the native fd at /dev/full: every write
+        fails with a REAL kernel ENOSPC."""
+
+        def __init__(self, fd):
+            self._fd = fd
+
+        def fileno(self):
+            return self._fd
+
+        def flush(self):
+            pass
+
+        def write(self, data):  # python fallback path, same errno
+            os.write(self._fd, data)
+
+    real_file = wal._file
+    fd = os.open("/dev/full", os.O_WRONLY)
+    try:
+        wal._file = _FullShim(fd)
+        wal.write("u1", 4, 1, pickle.dumps("v4"))
+        wal.flush()
+        assert wal.failed and wal.degraded
+        assert wal.failure_class == "space"
+    finally:
+        wal._file = real_file
+        os.close(fd)
+    tables2 = TableRegistry()
+    Wal(str(tmp_path / "wal"), tables2, Sink(), threaded=False,
+        sync_method="none")
+    mt = tables2.mem_table("u1")
+    assert [mt.get(i).cmd for i in (1, 2, 3)] == ["v1", "v2", "v3"]
+    assert mt.get(4) is None
+
+
+def test_native_python_frame_byte_parity_fuzz():
+    """Seeded fuzz over record shapes: the native framer must emit
+    byte-identical frames to the Python fallback (the recovery reader
+    cannot tell which framer wrote a file)."""
+    from ra_tpu import native
+    from ra_tpu.log import wal as wal_mod
+
+    if not native.available():
+        pytest.skip("native wal unavailable")
+    rng = random.Random(20)
+    for case in range(25):
+        records = []
+        for r in range(rng.randrange(1, 12)):
+            kind = rng.choice((wal_mod.K_ENTRY, wal_mod.K_UID,
+                               wal_mod.K_TRUNC))
+            if kind == wal_mod.K_UID:
+                ub = f"u{rng.randrange(5)}".encode()
+                records.append((wal_mod.K_UID, rng.randrange(1, 9),
+                                len(ub), 0, ub))
+            elif kind == wal_mod.K_TRUNC:
+                records.append((wal_mod.K_TRUNC, rng.randrange(1, 9),
+                                rng.randrange(1, 1000),
+                                rng.randrange(1, 50), b""))
+            else:
+                payload = os.urandom(rng.randrange(0, 200))
+                records.append((wal_mod.K_ENTRY, rng.randrange(1, 9),
+                                rng.randrange(1, 1000),
+                                rng.randrange(1, 50), payload))
+        for crc in (True, False):
+            nat = native.frame_batch(records, compute_crc=crc)
+            assert nat is not None, f"case {case}: native declined"
+            py = wal_mod.Wal._frame.__get__(
+                _FrameShim(crc))(records)
+            assert nat == py, f"case {case} crc={crc}: byte mismatch"
+
+
+class _FrameShim:
+    """Just enough Wal surface for _frame: no native, no counters."""
+
+    def __init__(self, crc):
+        self._native = False
+        self.compute_checksums = crc
+
+
+# ---------------------------------------------------------------------------
+# watermark controller
+
+
+def test_disk_watermark_hysteresis():
+    wm = DiskWatermark(soft_bytes=100, hard_bytes=200)
+    assert wm.tick(50) == [] and wm.state == 0
+    assert wm.tick(120) == ["soft_enter"] and wm.state == 1
+    assert wm.tick(130) == []  # still over: no re-fire
+    assert wm.tick(95) == []   # inside the hysteresis band: stays soft
+    assert wm.tick(84) == ["soft_exit"] and wm.state == 0
+    assert wm.tick(250) == ["hard_enter", "soft_enter"] and wm.state == 2
+    assert wm.tick(160) == ["hard_exit"] and wm.state == 1
+    assert wm.tick(10) == ["soft_exit"] and wm.state == 0
+
+
+def test_disk_watermark_disabled_at_zero():
+    wm = DiskWatermark()
+    assert wm.tick(10**15) == [] and wm.state == 0
+
+
+def test_disk_watermark_rejects_inverted_limits():
+    with pytest.raises(ValueError):
+        DiskWatermark(soft_bytes=200, hard_bytes=100)
+
+
+def test_brownout_detector_streak_and_hysteresis():
+    bd = BrownoutDetector(enter_us=1000.0, exit_us=100.0, streak=2,
+                          alpha=1.0)
+    assert bd.sample(0, 0) == []  # baseline
+    assert bd.sample(1, 5000) == []       # 1 slow tick: streak not met
+    assert bd.sample(2, 10_000) == ["enter"]
+    assert bd.active
+    assert bd.sample(3, 15_000) == []     # still slow: no re-fire
+    assert bd.sample(4, 15_050) == []     # 1 fast tick
+    assert bd.sample(5, 15_100) == ["exit"]
+    assert not bd.active
+
+
+def test_brownout_detector_idle_and_counter_reset():
+    bd = BrownoutDetector(enter_us=1000.0, exit_us=100.0, streak=1,
+                          alpha=1.0)
+    bd.sample(0, 0)
+    assert bd.sample(1, 5000) == ["enter"]
+    # counter reset (WAL re-registered): tolerated, no transition
+    assert bd.sample(0, 0) == []
+    # idle ticks decay the gauge toward zero -> exit
+    assert bd.sample(0, 0) == ["exit"]
+
+
+def test_dir_bytes(tmp_path):
+    (tmp_path / "a").write_bytes(b"x" * 100)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b").write_bytes(b"y" * 50)
+    assert dir_bytes(str(tmp_path)) == 150
+    assert dir_bytes(str(tmp_path / "missing")) == 0
+
+
+# ---------------------------------------------------------------------------
+# pressure state machine + snapshot credits
+
+
+def test_storage_pressure_gate_and_credits():
+    p = StoragePressure("tp_gate_node")
+    try:
+        assert not p.blocked()
+        assert p.snapshot_credits(4) == 4
+        assert p.enter_degraded(detail="test") is True
+        assert p.enter_degraded(detail="dup") is False  # episode owner
+        assert p.blocked()
+        assert p.snapshot_credits(4) == 0  # starve the sender
+        w = p.waiter()
+        assert not w.wait(timeout=0.05)  # parked while degraded
+        assert p.exit_degraded() is True
+        assert p.exit_degraded() is False
+        assert w.wait(timeout=1.0)  # resume wakes parked clients
+        p.set_hard(True)
+        assert p.blocked() and p.snapshot_credits(4) == 0
+        p.set_hard(False)
+        assert not p.blocked()
+    finally:
+        p.delete()
+
+
+def test_snapshot_sender_credit_window():
+    from types import SimpleNamespace
+
+    from ra_tpu.protocol import InstallSnapshotAck
+    from ra_tpu.runtime.proc import SnapshotSender
+
+    proc = SimpleNamespace(server=SimpleNamespace(id=("g", "n")))
+    s = SnapshotSender(proc, ("g", "peer"), meta=None, state_obj=None,
+                       live_entries=[], term=1, chunk_size=64)
+    probes = []
+    # window grant: ack(0, credits=3) authorizes chunks 1..3
+    s.on_ack(InstallSnapshotAck(1, 0, 3))
+    assert s._acquire_credit(3, 0.2, probes.append) == "ok"
+    assert s._acquire_credit(4, 0.15, lambda *a: probes.append(a)) \
+        == "timeout"
+    # starvation probed by re-sending the last acked chunk_no
+    assert probes and probes[-1][0] == 0
+    # zero-credit ack (degraded receiver) never advances the window
+    s.on_ack(InstallSnapshotAck(1, 3, 0))
+    assert s.window_until == 3
+    assert s._acquire_credit(4, 0.1, lambda *a: None) == "timeout"
+    # a later grant opens it
+    s.on_ack(InstallSnapshotAck(1, 3, 2))
+    assert s._acquire_credit(4, 0.2, lambda *a: None) == "ok"
+
+
+# ---------------------------------------------------------------------------
+# node integration: degrade -> typed rejects -> reclaim -> probe resume
+
+
+class _KvMachine:
+    pass  # registered via module-level factory below
+
+
+def _mk_kv():
+    from ra_tpu.machine import Machine
+
+    class KV(Machine):
+        def init(self, config):
+            return {}
+
+        def apply(self, meta, cmd, state):
+            state = dict(state)
+            state[cmd[1]] = cmd[2]
+            return state, ("ok", cmd[2]), []
+
+    return KV
+
+
+@pytest.mark.slow
+def test_node_enospc_degrades_rejects_typed_and_resumes(tmp_path):
+    KV = _mk_kv()
+    api.start_node(
+        "tpn0", SystemConfig(name="tpn", data_dir=str(tmp_path / "tpn0")),
+        election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
+    )
+    from ra_tpu.runtime.transport import registry
+
+    node = registry().get("tpn0")
+    try:
+        api.start_cluster("tpnc", KV, [("g0", "tpn0")], timeout=10)
+        api.process_command(("g0", "tpn0"), ("put", "k", 1), timeout=5)
+        faults.arm("wal.write", ("raise", "enospc"), ("always",), seed=3,
+                   scope="tpn0")
+        # first write after arming kills the WAL -> storage_degraded
+        with pytest.raises(api.RaError):
+            api.process_command(("g0", "tpn0"), ("put", "k", 2), timeout=1.5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not node.pressure.degraded:
+            time.sleep(0.02)
+        assert node.pressure.degraded
+        assert node.overview()["storage_degraded"]
+        # typed RA_NOSPACE reject for new commands while degraded
+        with pytest.raises(api.RaNoSpace):
+            api.process_command(("g0", "tpn0"), ("put", "k", 3), timeout=1.0)
+        # reads keep working: no new disk needed
+        out = api.consistent_query(("g0", "tpn0"), lambda s: dict(s),
+                                   timeout=5)
+        assert out[1]["k"] == 1
+        # no supervision-intensity budget consumed by the space episode
+        assert not node.infra_down
+        assert len(node._infra_restarts) == 0
+        # reclaim fired at degrade entry
+        assert node.pressure.counter.get("disk_reclaims") >= 1
+        # storm ends: the probe loop must auto-resume the node
+        faults.disarm("wal.write")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and node.pressure.degraded:
+            time.sleep(0.05)
+        assert not node.pressure.degraded
+        assert node.pressure.counter.get("disk_probe_attempts") >= 1
+        reply, _ = api.process_command(("g0", "tpn0"), ("put", "k", 4),
+                                       timeout=10)
+        assert reply == ("ok", 4)
+    finally:
+        faults.disarm_all()
+        api.stop_node("tpn0")
+
+
+@pytest.mark.slow
+def test_brownout_sheds_leadership_and_recovers(tmp_path):
+    KV = _mk_kv()
+    cfg = dict(brownout_enter_us=10_000.0, brownout_exit_us=2_000.0,
+               brownout_streak=2, disk_check_interval_s=0.1)
+    for n in ("tbn0", "tbn1", "tbn2"):
+        api.start_node(
+            n, SystemConfig(name="tbn", data_dir=str(tmp_path / n), **cfg),
+            election_timeout_s=0.15, tick_interval_s=0.1,
+            detector_poll_s=0.05,
+        )
+    from ra_tpu.runtime.transport import registry
+
+    ids = [("g0", "tbn0"), ("g0", "tbn1"), ("g0", "tbn2")]
+    try:
+        api.start_cluster("tbnc", KV, ids, timeout=15)
+        api.process_command(ids[0], ("put", "k", 0), timeout=10)
+        from ra_tpu import leaderboard
+
+        lead = leaderboard.lookup_leader(api._cluster_of(ids[0]))
+        assert lead is not None
+        victim = lead[1]
+        node = registry().get(victim)
+        faults.arm("wal.fsync", ("latency", 0.03), ("always",), seed=7,
+                   scope=victim)
+        # sustained slow fsyncs on the leader: detector must trip and
+        # shed its leadership to a clean peer
+        deadline = time.monotonic() + 15
+        i = 0
+        while time.monotonic() < deadline and not node.pressure.brownout:
+            i += 1
+            try:
+                api.process_command(ids[i % 3], ("put", "k", i), timeout=5)
+            except api.RaError:
+                pass
+        assert node.pressure.brownout
+        deadline = time.monotonic() + 10
+        shed = False
+        while time.monotonic() < deadline and not shed:
+            lead2 = leaderboard.lookup_leader(api._cluster_of(ids[0]))
+            shed = lead2 is not None and lead2[1] != victim
+            if not shed:
+                time.sleep(0.1)
+        assert shed, "brownout never shed leadership off the slow node"
+        # latency clears -> detector un-marks
+        faults.disarm("wal.fsync")
+        deadline = time.monotonic() + 15
+        i = 0
+        while time.monotonic() < deadline and node.pressure.brownout:
+            i += 1
+            try:
+                api.process_command(ids[i % 3], ("put", "k2", i), timeout=5)
+            except api.RaError:
+                pass
+        assert not node.pressure.brownout
+        assert node.pressure.counter.get("brownout_sheds") >= 1
+    finally:
+        faults.disarm_all()
+        for n in ("tbn0", "tbn1", "tbn2"):
+            try:
+                api.stop_node(n)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+@pytest.mark.slow
+def test_soft_watermark_emergency_reclaim(tmp_path):
+    """A byte budget below the working set: the watermark controller
+    must trip soft, run emergency reclamation (force snapshot ->
+    cursors -> major compaction), and publish the disk_pressure
+    anomaly through the health plane."""
+    KV = _mk_kv()
+    api.start_node(
+        "twm0", SystemConfig(
+            name="twm", data_dir=str(tmp_path / "twm0"),
+            disk_soft_limit_bytes=1, disk_check_interval_s=0.1,
+            min_snapshot_interval=1,
+        ),
+        election_timeout_s=0.15, tick_interval_s=0.1, detector_poll_s=0.05,
+    )
+    from ra_tpu.runtime.transport import registry
+
+    node = registry().get("twm0")
+    try:
+        api.start_cluster("twmc", KV, [("g0", "twm0")], timeout=10)
+        for i in range(20):
+            api.process_command(("g0", "twm0"), ("put", f"k{i}", "x" * 256),
+                                timeout=5)
+        deadline = time.monotonic() + 5
+        c = node.pressure.counter
+        while time.monotonic() < deadline and not c.get("disk_soft_trips"):
+            time.sleep(0.05)
+        assert c.get("disk_soft_trips") >= 1
+        assert c.get("disk_reclaims") >= 1
+        assert c.get("disk_used_bytes") > 0
+        assert node._watermark.state == 1
+        assert node._health.summary()["disk_pressure"] == "soft"
+        assert node.overview()["disk_pressure_state"] == 1
+    finally:
+        api.stop_node("twm0")

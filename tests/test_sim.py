@@ -329,3 +329,66 @@ def test_sim_sweep_lane(workload, sim_seed_base):
         print(f["minimized"])
     assert not summary["failures"], \
         f"{len(summary['failures'])} schedule(s) failed; minimized repros printed above"
+
+
+# -- disk-space model (docs/INTERNALS.md §21) ---------------------------------------
+
+
+def _disk_sched(budget: int) -> Schedule:
+    # paced seq puts so each commits (and acks) before the next lands;
+    # the byte budget exhausts mid-stream on every replica at the same
+    # entry, since replicated logs account identically
+    ops = tuple((200 + 150 * i, ("cmd", ("put", "seq", i)))
+                for i in range(20))
+    return Schedule(seed=0, workload="kv", nodes=3, horizon_ms=4_000,
+                    settle_ms=3_000, disk_budget_bytes=budget, ops=ops)
+
+
+def test_disk_budget_degrades_and_acked_writes_survive():
+    """Exhaustion under the clean space-class path: nodes park writes
+    (degraded), availability is lost for the episode, but after the
+    horizon heal every acked write is still there — zero violations."""
+    r = run_schedule(_disk_sched(600))
+    assert r.ok, r.violations
+    kinds = {ln.split()[0] for ln in r.trace_text.splitlines()}
+    assert "disk_full" in kinds, "budget never exhausted"
+    assert "disk_heal" in kinds, "exhausted node never healed"
+
+
+def test_disk_budget_determinism():
+    a = run_schedule(_disk_sched(600))
+    b = run_schedule(_disk_sched(600))
+    assert a.trace_text == b.trace_text
+    assert a.final == b.final
+
+
+def test_disk_budget_roundtrips_through_dumps():
+    sched = _disk_sched(600)
+    back = loads(dumps(sched))
+    assert back.disk_budget_bytes == 600
+    assert run_schedule(back).trace_text == run_schedule(sched).trace_text
+
+
+def test_sim_finds_and_shrinks_space_as_poison_bug(monkeypatch):
+    """The §21 misclassification demo: with the planted bug on,
+    space-class failures poison the node and 'recovery' truncates the
+    durable tail — every replica truncates the same committed entry,
+    the acked-writes-survive oracle fires, and ddmin shrinks the repro
+    to a handful of ops that still reproduce it."""
+    import ra_tpu.sim.world as world_mod
+
+    monkeypatch.setattr(world_mod, "SIM_BUG_SPACE_AS_POISON", True)
+    r = run_schedule(_disk_sched(600))
+    assert not r.ok, "planted space-as-poison bug went undetected"
+    assert "acked write lost" in r.violations[0]
+    assert "disk_poison" in r.trace_text
+
+    minimized, replays = shrink(r.schedule)
+    assert len(minimized.ops) <= 8, \
+        f"shrinker left {len(minimized.ops)} ops ({replays} replays)"
+    assert not run_schedule(minimized).ok, \
+        "minimized schedule no longer reproduces the bug"
+
+    monkeypatch.setattr(world_mod, "SIM_BUG_SPACE_AS_POISON", False)
+    assert run_schedule(minimized).ok, \
+        "minimized schedule fails even without the planted bug"
